@@ -1,0 +1,31 @@
+"""ceph_tpu — TPU-native erasure coding + CRUSH placement framework.
+
+A brand-new JAX/XLA/Pallas framework with the erasure-coding and placement
+capabilities of the reference (agraf/ceph, a fork of ceph/ceph):
+
+- ``ceph_tpu.gf``       — GF(2^w) arithmetic core (poly 0x11D for w=8,
+                          matching jerasure/gf-complete and ISA-L).
+- ``ceph_tpu.matrices`` — code-matrix generators replicating
+                          src/erasure-code/jerasure (reed_sol.c, cauchy.c,
+                          liberation.c) and ISA-L (ec_base.c) algorithms.
+- ``ceph_tpu.ops``      — batched encode/decode compute paths: an XLA path
+                          (constant-multiplier XOR chains) and Pallas
+                          bit-plane MXU kernels.
+- ``ceph_tpu.codes``    — the plugin framework: ErasureCodeInterface,
+                          ErasureCode base class, plugin registry, and the
+                          jerasure/isa/shec/clay/lrc-equivalent plugins
+                          (mirrors src/erasure-code/).
+- ``ceph_tpu.crush``    — CRUSH: rjenkins1 hash, straw2 (crush_ln LUT),
+                          crush_do_rule, and a vmapped bulk evaluator
+                          (mirrors src/crush/).
+- ``ceph_tpu.parallel`` — device-mesh sharding of the batched paths.
+- ``ceph_tpu.bench``    — CLI harness mirroring
+                          src/test/erasure-code/ceph_erasure_code_benchmark.cc
+                          and src/tools/crushtool.cc --test.
+- ``ceph_tpu.utils``    — profiles/config, perf counters, logging.
+
+Reference citations in docstrings use ``path -> symbol`` form per SURVEY.md §0
+(the reference mount was empty; citations are upstream-layout paths).
+"""
+
+__version__ = "0.1.0"

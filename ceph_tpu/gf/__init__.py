@@ -1,0 +1,31 @@
+"""GF(2^w) arithmetic core.
+
+Replaces, at the math level, the vendored gf-complete library
+(src/erasure-code/jerasure/gf-complete -> gf_w8_* region ops) and jerasure's
+galois.c scalar helpers (src/erasure-code/jerasure/jerasure/src/galois.c ->
+galois_single_multiply / galois_single_divide).
+"""
+
+from .gf8 import (
+    GF8_POLY,
+    DEFAULT_POLY,
+    gf_mul,
+    gf_div,
+    gf_inv,
+    gf_pow,
+    GF8,
+    gf8,
+)
+from .matrix import (
+    gf_matmul,
+    gf_matvec,
+    gf_invert_matrix,
+    gf_gaussian_inverse,
+    is_invertible,
+)
+from .bitmatrix import (
+    value_to_bitmatrix,
+    matrix_to_bitmatrix,
+    bitmatrix_n_ones,
+    cauchy_n_ones,
+)

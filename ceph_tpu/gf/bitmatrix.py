@@ -1,0 +1,90 @@
+"""GF(2^w) value <-> w x w GF(2) bit-matrix transforms.
+
+Replicates jerasure's bit-matrix machinery (SURVEY.md §2.1 "jerasure
+(vendored)"):
+- jerasure/src/jerasure.c -> jerasure_matrix_to_bitmatrix: the w x w block
+  for element e has column x equal to the bit-pattern of e * 2^x (bit l of
+  that product goes to row l).
+- jerasure/src/cauchy.c -> cauchy_n_ones: number of ones in the bit-matrix
+  of a value (used by cauchy_good_general_coding_matrix to pick the
+  lightest-weight row scaling).
+
+The bit-matrix form is also the TPU-native representation: multiplying by a
+constant becomes w XOR-accumulated bit-plane selections, i.e. a GF(2) matmul
+that maps straight onto the MXU (see ceph_tpu.ops.pallas_gf).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .gf8 import gf_mul
+
+
+def value_to_bitmatrix(e: int, w: int = 8, poly: int | None = None) -> np.ndarray:
+    """w x w GF(2) matrix B of value e: B[l, x] = bit l of (e * 2^x).
+
+    Multiplying the bit-column-vector of v by B yields the bit-vector of
+    e*v, because column x is the image of basis vector 2^x.
+    """
+    out = np.zeros((w, w), dtype=np.uint8)
+    elt = e
+    for x in range(w):
+        for l in range(w):
+            out[l, x] = (elt >> l) & 1
+        elt = gf_mul(elt, 2, w, poly)
+    return out
+
+
+def matrix_to_bitmatrix(k: int, m: int, w: int, matrix, poly: int | None = None) -> np.ndarray:
+    """jerasure_matrix_to_bitmatrix: (m,k) GF matrix -> (m*w, k*w) GF(2) matrix.
+
+    Layout matches jerasure row-major flattening: block (i, j) occupies rows
+    [i*w, (i+1)*w), cols [j*w, (j+1)*w).
+    """
+    matrix = np.asarray(matrix).reshape(m, k)
+    out = np.zeros((m * w, k * w), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            out[i * w:(i + 1) * w, j * w:(j + 1) * w] = value_to_bitmatrix(
+                int(matrix[i, j]), w, poly)
+    return out
+
+
+def bitmatrix_n_ones(e: int, w: int = 8, poly: int | None = None) -> int:
+    """Number of ones in value_to_bitmatrix(e) — cauchy_n_ones equivalent."""
+    n = 0
+    elt = e
+    for _ in range(w):
+        n += bin(elt).count("1")
+        elt = gf_mul(elt, 2, w, poly)
+    return n
+
+
+# jerasure name (cauchy.c -> cauchy_n_ones)
+cauchy_n_ones = bitmatrix_n_ones
+
+
+def gf2_rank(mat: np.ndarray) -> int:
+    """Rank of a 0/1 matrix over GF(2) (bit-packed row elimination).
+
+    Used by bitmatrix decode paths to pick invertible survivor sets, the
+    role jerasure_invert_bitmatrix plays for jerasure_bitmatrix_decode.
+    """
+    a = [int("".join(str(int(b)) for b in row), 2)
+         for row in np.asarray(mat) % 2]
+    rank = 0
+    for col in range(np.asarray(mat).shape[1] - 1, -1, -1):
+        piv = None
+        for i in range(rank, len(a)):
+            if (a[i] >> col) & 1:
+                piv = i
+                break
+        if piv is None:
+            continue
+        a[rank], a[piv] = a[piv], a[rank]
+        for i in range(len(a)):
+            if i != rank and (a[i] >> col) & 1:
+                a[i] ^= a[rank]
+        rank += 1
+    return rank

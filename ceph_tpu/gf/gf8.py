@@ -1,0 +1,167 @@
+"""Scalar + numpy GF(2^w) arithmetic, exact to jerasure/gf-complete and ISA-L.
+
+Reference behavior replicated (SURVEY.md §2.1 "gf-complete (vendored)"):
+- src/erasure-code/jerasure/gf-complete -> gf_w8 default polynomial 0x11D
+  (x^8 + x^4 + x^3 + x^2 + 1); ISA-L's erasure_code/ec_base.c uses the same
+  field, so one core serves both plugin families byte-for-byte.
+- src/erasure-code/jerasure/jerasure/src/galois.c -> galois_single_multiply,
+  galois_single_divide for w in {4, 8, 16, 32} with the classic default
+  polynomials (galois.c: 0x13, 0x11D, 0x1100B, 0x400007).
+
+The product is defined mathematically (carry-less multiply then reduction by
+the field polynomial), so any correct implementation is bit-identical to the
+reference's table/SIMD kernels. The numpy fast path for w=8 uses a full
+256x256 product table (64 KiB) — this is the *host* path; the TPU paths live
+in ceph_tpu.ops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Default primitive polynomials, matching jerasure's galois.c
+# (galois_create_log_tables / galois_single_multiply defaults) and gf-complete.
+DEFAULT_POLY = {
+    1: 0x3,
+    2: 0x7,
+    3: 0xB,
+    4: 0x13,
+    8: 0x11D,
+    16: 0x1100B,
+    32: 0x400007,  # interpreted with implicit x^32 term, see _reduce
+}
+
+GF8_POLY = DEFAULT_POLY[8]
+
+
+def _clmul(a: int, b: int) -> int:
+    """Carry-less (XOR) multiply of two non-negative ints."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        a <<= 1
+        b >>= 1
+    return r
+
+
+def _reduce(x: int, w: int, poly: int) -> int:
+    """Reduce x modulo the degree-w polynomial ``poly``.
+
+    For w < 32 ``poly`` includes the x^w term (e.g. 0x11D for w=8).
+    For w == 32 jerasure/gf-complete specify the polynomial *without* the
+    implicit x^32 term (0x400007 means x^32 + x^22 + x^2 + x + 1), so we add
+    it back here.
+    """
+    full = poly | (1 << w) if poly < (1 << w) else poly
+    deg = full.bit_length() - 1
+    while x.bit_length() - 1 >= deg:
+        x ^= full << (x.bit_length() - 1 - deg)
+    return x
+
+
+def gf_mul(a: int, b: int, w: int = 8, poly: int | None = None) -> int:
+    """galois_single_multiply(a, b, w) — exact scalar GF(2^w) product."""
+    if a == 0 or b == 0:
+        return 0
+    if poly is None:
+        poly = DEFAULT_POLY[w]
+    return _reduce(_clmul(a, b), w, poly)
+
+
+def gf_pow(a: int, n: int, w: int = 8, poly: int | None = None) -> int:
+    """a**n in GF(2^w) by square-and-multiply."""
+    r = 1
+    base = a
+    while n:
+        if n & 1:
+            r = gf_mul(r, base, w, poly)
+        base = gf_mul(base, base, w, poly)
+        n >>= 1
+    return r
+
+
+def gf_inv(a: int, w: int = 8, poly: int | None = None) -> int:
+    """Multiplicative inverse via Fermat: a^(2^w - 2)."""
+    if a == 0:
+        raise ZeroDivisionError("GF inverse of 0")
+    return gf_pow(a, (1 << w) - 2, w, poly)
+
+
+def gf_div(a: int, b: int, w: int = 8, poly: int | None = None) -> int:
+    """galois_single_divide(a, b, w)."""
+    if b == 0:
+        raise ZeroDivisionError("GF division by 0")
+    if a == 0:
+        return 0
+    return gf_mul(a, gf_inv(b, w, poly), w, poly)
+
+
+class GF8:
+    """GF(2^8) with full tables for fast host-side (numpy) work.
+
+    Table layout mirrors gf-complete's log/antilog construction
+    (gf-complete/src/gf_w8.c -> gf_w8_log_init) but the authoritative
+    definition is polynomial arithmetic with poly 0x11D, so the tables are
+    generated, not copied.
+    """
+
+    def __init__(self, poly: int = GF8_POLY):
+        self.poly = poly
+        self.w = 8
+        # exp/log with generator 2 (primitive for 0x11D).
+        exp = np.zeros(512, dtype=np.uint8)
+        log = np.zeros(256, dtype=np.int32)
+        x = 1
+        for i in range(255):
+            exp[i] = x
+            log[x] = i
+            x = gf_mul(x, 2, 8, poly)
+        exp[255:510] = exp[0:255]
+        self.exp = exp
+        self.log = log
+        # Full 256x256 multiply table.
+        a = np.arange(256, dtype=np.int64)
+        la = log[a]
+        mul = np.zeros((256, 256), dtype=np.uint8)
+        idx = la[1:, None] + la[None, 1:]
+        mul[1:, 1:] = exp[idx]
+        self.mul_table = mul
+        inv = np.zeros(256, dtype=np.uint8)
+        inv[1:] = exp[(255 - log[np.arange(1, 256)]) % 255]
+        self.inv_table = inv
+
+    def mul(self, a, b):
+        """Elementwise GF(2^8) product of uint8 arrays (numpy broadcast)."""
+        a = np.asarray(a, dtype=np.uint8)
+        b = np.asarray(b, dtype=np.uint8)
+        return self.mul_table[a.astype(np.int64), b.astype(np.int64)]
+
+    def inv(self, a):
+        a = np.asarray(a, dtype=np.uint8)
+        if np.any(a == 0):
+            raise ZeroDivisionError("GF inverse of 0")
+        return self.inv_table[a.astype(np.int64)]
+
+    def div(self, a, b):
+        return self.mul(a, self.inv(b))
+
+    def mul_const_region(self, c: int, region: np.ndarray) -> np.ndarray:
+        """Multiply a whole uint8 region by constant c.
+
+        Equivalent of gf-complete's multiply_region.w8 (the SSE split-table
+        kernel's job) on the host.
+        """
+        return self.mul_table[int(c)][region.astype(np.int64)]
+
+
+@functools.lru_cache(maxsize=4)
+def _gf8_cached(poly: int) -> GF8:
+    return GF8(poly)
+
+
+def gf8(poly: int = GF8_POLY) -> GF8:
+    """Shared GF8 instance (tables built once)."""
+    return _gf8_cached(poly)

@@ -1,0 +1,87 @@
+"""GF(2^w) matrix algebra on the host (numpy / python ints).
+
+Replicates the matrix paths used by the reference plugins:
+- jerasure's decode inversion (jerasure/src/jerasure.c ->
+  jerasure_invert_matrix, used by jerasure_matrix_decode).
+- ISA-L's gf_invert_matrix (isa-l/erasure_code/ec_base.c), used by
+  ErasureCodeIsa decode (src/erasure-code/isa/ErasureCodeIsa.cc).
+
+Both are plain Gaussian elimination over GF(2^w); the result is unique, so
+one implementation serves both byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .gf8 import gf_inv, gf_mul
+
+
+def gf_matmul(a, b, w: int = 8, poly: int | None = None) -> np.ndarray:
+    """Matrix product over GF(2^w). a: (m,k) ints, b: (k,n) ints."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2
+    out = np.zeros((m, n), dtype=np.int64)
+    for i in range(m):
+        for j in range(n):
+            acc = 0
+            for t in range(k):
+                acc ^= gf_mul(int(a[i, t]), int(b[t, j]), w, poly)
+            out[i, j] = acc
+    return out
+
+
+def gf_matvec(a, v, w: int = 8, poly: int | None = None) -> np.ndarray:
+    return gf_matmul(a, np.asarray(v).reshape(-1, 1), w, poly).reshape(-1)
+
+
+def gf_gaussian_inverse(mat, w: int = 8, poly: int | None = None) -> np.ndarray | None:
+    """Invert a square matrix over GF(2^w); None if singular.
+
+    Same row-reduction order as jerasure_invert_matrix
+    (jerasure/src/jerasure.c): forward elimination with row swaps, then
+    back-substitution. Over a field the inverse is unique.
+    """
+    a = np.array(mat, dtype=np.int64, copy=True)
+    n = a.shape[0]
+    assert a.shape == (n, n)
+    inv = np.eye(n, dtype=np.int64)
+    for col in range(n):
+        pivot = -1
+        for row in range(col, n):
+            if a[row, col] != 0:
+                pivot = row
+                break
+        if pivot < 0:
+            return None
+        if pivot != col:
+            a[[col, pivot]] = a[[pivot, col]]
+            inv[[col, pivot]] = inv[[pivot, col]]
+        pv = int(a[col, col])
+        if pv != 1:
+            pinv = gf_inv(pv, w, poly)
+            for j in range(n):
+                a[col, j] = gf_mul(int(a[col, j]), pinv, w, poly)
+                inv[col, j] = gf_mul(int(inv[col, j]), pinv, w, poly)
+        for row in range(n):
+            if row != col and a[row, col] != 0:
+                f = int(a[row, col])
+                for j in range(n):
+                    a[row, j] ^= gf_mul(f, int(a[col, j]), w, poly)
+                    inv[row, j] ^= gf_mul(f, int(inv[col, j]), w, poly)
+    return inv
+
+
+def gf_invert_matrix(mat, w: int = 8, poly: int | None = None) -> np.ndarray:
+    """ISA-L-style inversion (ec_base.c -> gf_invert_matrix); raises if singular."""
+    out = gf_gaussian_inverse(mat, w, poly)
+    if out is None:
+        raise np.linalg.LinAlgError("matrix is singular over GF(2^w)")
+    return out
+
+
+def is_invertible(mat, w: int = 8, poly: int | None = None) -> bool:
+    return gf_gaussian_inverse(mat, w, poly) is not None

@@ -1,0 +1,23 @@
+"""Code-matrix generators replicating the reference's algorithms exactly.
+
+These decide byte-identical parity (SURVEY.md §7 step 2): the GF math is
+unique, but each library post-processes its generator matrix in its own
+quirky way, and those quirks must be copied algorithm-for-algorithm.
+"""
+
+from .jerasure import (
+    reed_sol_extended_vandermonde_matrix,
+    reed_sol_big_vandermonde_distribution_matrix,
+    reed_sol_vandermonde_coding_matrix,
+    reed_sol_r6_coding_matrix,
+    cauchy_original_coding_matrix,
+    cauchy_good_general_coding_matrix,
+    cauchy_improve_coding_matrix,
+    liberation_coding_bitmatrix,
+    liber8tion_coding_bitmatrix,
+    blaum_roth_coding_bitmatrix,
+)
+from .isal import (
+    gf_gen_rs_matrix,
+    gf_gen_cauchy1_matrix,
+)

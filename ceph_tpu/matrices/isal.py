@@ -1,0 +1,54 @@
+"""ISA-L generator matrices (isa-l/erasure_code/ec_base.c), exact.
+
+Used by the isa-compatible plugin (src/erasure-code/isa/ErasureCodeIsa.cc ->
+ErasureCodeIsaDefault::prepare, which calls gf_gen_rs_matrix for
+technique=reed_sol_van and gf_gen_cauchy1_matrix for technique=cauchy).
+ISA-L's GF(2^8) uses the same 0x11D field as jerasure, so the shared core
+applies.
+
+ISA-L builds the full (k+m) x k matrix with the identity on top; the plugin
+hands rows [k, k+m) to the encoder. Both shapes are exposed here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..gf.gf8 import gf_inv, gf_mul
+
+
+def gf_gen_rs_matrix(m: int, k: int) -> np.ndarray:
+    """ec_base.c -> gf_gen_rs_matrix: identity on top, then rows g_i^j.
+
+    Row k+i (i = 0, 1, 2, ...) is [p^0, p^1, ... ] with p generated as
+    gen=1 doubling per row: row k is all ones, row k+1 is 2^j, row k+2 is
+    4^j, ... (w=8, poly 0x11D). Shape (m, k) where m = total rows
+    (ISA-L's "m" counts data+parity).
+    """
+    a = np.zeros((m, k), dtype=np.int64)
+    for i in range(k):
+        a[i, i] = 1
+    gen = 1
+    for i in range(k, m):
+        p = 1
+        for j in range(k):
+            a[i, j] = p
+            p = gf_mul(p, gen, 8)
+        gen = gf_mul(gen, 2, 8)
+    return a
+
+
+def gf_gen_cauchy1_matrix(m: int, k: int) -> np.ndarray:
+    """ec_base.c -> gf_gen_cauchy1_matrix: identity, then 1/(i ^ j)."""
+    a = np.zeros((m, k), dtype=np.int64)
+    for i in range(k):
+        a[i, i] = 1
+    for i in range(k, m):
+        for j in range(k):
+            a[i, j] = gf_inv(i ^ j, 8)
+    return a
+
+
+def isa_coding_rows(matrix: np.ndarray, k: int) -> np.ndarray:
+    """The (m, k) coding block the encoder actually uses (rows k..end)."""
+    return np.asarray(matrix)[k:].copy()

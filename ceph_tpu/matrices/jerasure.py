@@ -1,0 +1,300 @@
+"""jerasure matrix/bitmatrix generators, algorithm-for-algorithm.
+
+Replicates (SURVEY.md §2.1, §7 step 2):
+- jerasure/src/reed_sol.c -> reed_sol_extended_vandermonde_matrix,
+  reed_sol_big_vandermonde_distribution_matrix,
+  reed_sol_vandermonde_coding_matrix, reed_sol_r6_coding_matrix.
+  NOTE: jerasure post-processes the extended Vandermonde into *systematic*
+  form with a specific pivoting/scaling order; parity bytes depend on that
+  exact order, so it is copied here step by step (not the textbook form).
+- jerasure/src/cauchy.c -> cauchy_original_coding_matrix,
+  cauchy_good_general_coding_matrix, cauchy_improve_coding_matrix.
+- jerasure/src/liberation.c -> liberation_coding_bitmatrix,
+  blaum_roth_coding_bitmatrix, liber8tion_coding_bitmatrix.
+
+Vintage caveats (reference mount empty this round, SURVEY.md §0):
+- cauchy_good's m==2 "cbest" precomputed tables and liber8tion's hardcoded
+  search-derived bitmatrix cannot be byte-verified; those two paths are
+  implemented as documented deterministic constructions and flagged below.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..gf.gf8 import gf_div, gf_mul
+from ..gf.bitmatrix import cauchy_n_ones
+
+
+def reed_sol_extended_vandermonde_matrix(rows: int, cols: int, w: int) -> np.ndarray:
+    """reed_sol.c -> reed_sol_extended_vandermonde_matrix.
+
+    Row 0 = e_0, rows 1..rows-2 = geometric rows [i^0, i^1, ...], last row =
+    e_{cols-1} (that is what makes it "extended").
+    """
+    if w < 30 and (1 << w) < rows:
+        raise ValueError("rows too large for w")
+    if w < 30 and (1 << w) < cols:
+        raise ValueError("cols too large for w")
+    vdm = np.zeros((rows, cols), dtype=np.int64)
+    vdm[0, 0] = 1
+    vdm[rows - 1, cols - 1] = 1
+    for i in range(1, rows - 1):
+        acc = 1
+        for j in range(cols):
+            vdm[i, j] = acc
+            acc = gf_mul(acc, i, w)
+    return vdm
+
+
+def reed_sol_big_vandermonde_distribution_matrix(rows: int, cols: int, w: int) -> np.ndarray:
+    """reed_sol.c -> reed_sol_big_vandermonde_distribution_matrix.
+
+    Converts the extended Vandermonde matrix into systematic form
+    [I_k ; coding] using jerasure's exact elimination order: for each column
+    i pivot/swap, scale the column so (i,i)==1, eliminate row i across
+    columns; then normalize row `cols` (first coding row) to all ones via
+    column scaling, and finally scale every later coding row so its first
+    element is 1.
+    """
+    if cols >= rows:
+        raise ValueError("cols must be < rows")
+    dist = reed_sol_extended_vandermonde_matrix(rows, cols, w)
+
+    for i in range(1, cols):
+        # find a row j >= i with dist[j, i] != 0, swap it up to row i
+        j = i
+        while j < rows and dist[j, i] == 0:
+            j += 1
+        if j >= rows:
+            raise ArithmeticError("couldn't make distribution matrix")
+        if j != i:
+            tmp = dist[i].copy()
+            dist[i] = dist[j]
+            dist[j] = tmp
+        # scale column i so dist[i, i] == 1
+        if dist[i, i] != 1:
+            inv = gf_div(1, int(dist[i, i]), w)
+            for r in range(rows):
+                dist[r, i] = gf_mul(inv, int(dist[r, i]), w)
+        # eliminate: for every column j != i with e = dist[i, j] != 0,
+        # column_j ^= e * column_i  (makes row i == e_i)
+        for j in range(cols):
+            e = int(dist[i, j])
+            if j != i and e != 0:
+                for r in range(rows):
+                    dist[r, j] ^= gf_mul(e, int(dist[r, i]), w)
+
+    # make the first coding row (row `cols`) all ones, by column scaling
+    for j in range(cols):
+        e = int(dist[cols, j])
+        if e != 1:
+            inv = gf_div(1, e, w)
+            for r in range(cols, rows):
+                dist[r, j] = gf_mul(inv, int(dist[r, j]), w)
+
+    # make the first element of each later coding row 1, by row scaling
+    for i in range(cols + 1, rows):
+        e = int(dist[i, 0])
+        if e != 1:
+            inv = gf_div(1, e, w)
+            for j in range(cols):
+                dist[i, j] = gf_mul(int(dist[i, j]), inv, w)
+
+    return dist
+
+
+def reed_sol_vandermonde_coding_matrix(k: int, m: int, w: int) -> np.ndarray:
+    """reed_sol.c -> reed_sol_vandermonde_coding_matrix: (m, k) coding rows."""
+    vdm = reed_sol_big_vandermonde_distribution_matrix(k + m, k, w)
+    return vdm[k:k + m].copy()
+
+
+def reed_sol_r6_coding_matrix(k: int, w: int) -> np.ndarray:
+    """reed_sol.c -> reed_sol_r6_coding_matrix (RAID-6: P = XOR, Q = 2^j)."""
+    if w not in (8, 16, 32):
+        raise ValueError("reed_sol_r6 requires w in {8,16,32}")
+    matrix = np.zeros((2, k), dtype=np.int64)
+    matrix[0, :] = 1
+    acc = 1
+    matrix[1, 0] = 1
+    for j in range(1, k):
+        acc = gf_mul(acc, 2, w)
+        matrix[1, j] = acc
+    return matrix
+
+
+def cauchy_original_coding_matrix(k: int, m: int, w: int) -> np.ndarray:
+    """cauchy.c -> cauchy_original_coding_matrix: M[i, j] = 1 / (i ^ (m+j))."""
+    if w < 31 and (k + m) > (1 << w):
+        raise ValueError("k + m must be <= 2^w")
+    matrix = np.zeros((m, k), dtype=np.int64)
+    for i in range(m):
+        for j in range(k):
+            matrix[i, j] = gf_div(1, i ^ (m + j), w)
+    return matrix
+
+
+def cauchy_improve_coding_matrix(k: int, m: int, w: int, matrix: np.ndarray) -> np.ndarray:
+    """cauchy.c -> cauchy_improve_coding_matrix (in place; also returned).
+
+    1. Scale each column so row 0 is all ones.
+    2. For each later row, try scaling by the inverse of each element and
+       keep the scaling that minimizes total bit-matrix ones
+       (cauchy_n_ones); ties keep the earlier candidate, and the original
+       row wins unless strictly improved.
+    """
+    for j in range(k):
+        if matrix[0, j] != 1:
+            inv = gf_div(1, int(matrix[0, j]), w)
+            for i in range(m):
+                matrix[i, j] = gf_mul(int(matrix[i, j]), inv, w)
+    for i in range(1, m):
+        bno = sum(cauchy_n_ones(int(matrix[i, j]), w) for j in range(k))
+        bno_index = -1
+        for j in range(k):
+            if matrix[i, j] != 1:
+                inv = gf_div(1, int(matrix[i, j]), w)
+                tno = sum(
+                    cauchy_n_ones(gf_mul(int(matrix[i, x]), inv, w), w)
+                    for x in range(k))
+                if tno < bno:
+                    bno = tno
+                    bno_index = j
+        if bno_index != -1:
+            inv = gf_div(1, int(matrix[i, bno_index]), w)
+            for j in range(k):
+                matrix[i, j] = gf_mul(int(matrix[i, j]), inv, w)
+    return matrix
+
+
+@functools.lru_cache(maxsize=8)
+def _cbest_values(w: int) -> tuple[int, ...]:
+    """All nonzero field values sorted by (cauchy_n_ones, value)."""
+    return tuple(sorted(range(1, 1 << w),
+                        key=lambda v: (cauchy_n_ones(v, w), v)))
+
+
+def _cbest_row(k: int, w: int) -> list[int]:
+    """Best-known second RAID-6 row for cauchy_good when m == 2.
+
+    VINTAGE-UNCERTAIN (SURVEY.md §0): jerasure ships precomputed search
+    tables (cauchy_best_r6.c -> cbest_* arrays, covering w up to 32) that
+    cannot be re-derived byte-for-byte without the reference. This
+    deterministic equivalent enumerates nonzero field values in increasing
+    cauchy_n_ones order (ties by value) — the same objective the tables
+    were generated from. Re-verify against cauchy.c once the reference
+    mount is available.
+    """
+    return list(_cbest_values(w)[:k])
+
+
+def cauchy_good_general_coding_matrix(k: int, m: int, w: int) -> np.ndarray:
+    """cauchy.c -> cauchy_good_general_coding_matrix.
+
+    The m == 2 fast path uses the cbest-style row for w <= 16 (dynamic
+    enumeration; see _cbest_row). DIVERGENCE NOTE: jerasure's cbest tables
+    also cover w = 32, which this implementation cannot enumerate — m == 2
+    with w = 32 falls through to cauchy_original + improve and will not
+    match the reference's bytes for that configuration.
+    """
+    if m == 2 and w <= 16 and k <= (1 << w) - 1:
+        row = _cbest_row(k, w)
+        matrix = np.zeros((2, k), dtype=np.int64)
+        matrix[0, :] = 1
+        matrix[1, :] = row
+        return matrix
+    matrix = cauchy_original_coding_matrix(k, m, w)
+    return cauchy_improve_coding_matrix(k, m, w, matrix)
+
+
+# ---------------------------------------------------------------------------
+# Minimal-density RAID-6 bitmatrix techniques (liberation.c)
+# ---------------------------------------------------------------------------
+
+def liberation_coding_bitmatrix(k: int, w: int) -> np.ndarray:
+    """liberation.c -> liberation_coding_bitmatrix: (2w, k*w) GF(2) matrix.
+
+    Requires w prime, k <= w. P block = k identity matrices (plain XOR
+    parity). Q block for data column j = identity rotated down by j, plus
+    (for j > 0) one extra 1 at row i = j*(w-1)/2 mod w, column (i+j-1) mod w
+    — Plank's Liberation construction.
+    """
+    if k > w:
+        raise ValueError("liberation requires k <= w")
+    if w >= 2 and any(w % p == 0 for p in range(2, w)):
+        raise ValueError("liberation requires prime w")
+    bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for i in range(w):
+        for j in range(k):
+            bm[i, j * w + i] = 1
+    for j in range(k):
+        for i in range(w):
+            bm[w + i, j * w + (j + i) % w] = 1
+        if j > 0:
+            i = (j * ((w - 1) // 2)) % w
+            bm[w + i, j * w + (i + j - 1) % w] = 1
+    return bm
+
+
+def blaum_roth_coding_bitmatrix(k: int, w: int) -> np.ndarray:
+    """liberation.c -> blaum_roth_coding_bitmatrix: (2w, k*w) GF(2) matrix.
+
+    Blaum-Roth codes work in the ring R = GF(2)[x]/M_p(x) with p = w + 1
+    prime and M_p(x) = 1 + x + ... + x^w; the Q block for data column j is
+    the matrix of multiplication by x^j in R (x^w == sum of lower powers).
+    P block is plain XOR. Column-convention matches
+    ceph_tpu.gf.bitmatrix.value_to_bitmatrix (column c = image of basis c).
+
+    VINTAGE-UNCERTAIN (SURVEY.md §0): the math above is the published
+    Blaum-Roth construction, but liberation.c's exact column convention
+    (x^j vs x^-j, block transposition) could not be byte-checked against
+    the empty reference mount. The Q_j == Mx^j structure is pinned by
+    tests; re-verify the convention once the mount works.
+    """
+    if k > w:
+        raise ValueError("blaum_roth requires k <= w")
+    p = w + 1
+    if any(p % q == 0 for q in range(2, p)):
+        raise ValueError("blaum_roth requires w+1 prime")
+    # multiplication-by-x matrix in R
+    mx = np.zeros((w, w), dtype=np.uint8)
+    for c in range(w - 1):
+        mx[c + 1, c] = 1
+    mx[:, w - 1] = 1
+    bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+    q = np.eye(w, dtype=np.uint8)
+    for j in range(k):
+        for i in range(w):
+            bm[i, j * w + i] = 1
+        bm[w:2 * w, j * w:(j + 1) * w] = q
+        q = (mx @ q) % 2
+    return bm
+
+
+def liber8tion_coding_bitmatrix(k: int) -> np.ndarray:
+    """liberation.c -> liber8tion_coding_bitmatrix (w = 8, m = 2, k <= 8).
+
+    VINTAGE-UNCERTAIN (SURVEY.md §0): upstream ships a hardcoded bitmatrix
+    found by exhaustive search (Plank, "The RAID-6 Liber8tion Code") that
+    cannot be re-derived without the reference. This implementation builds
+    a provably-MDS RAID-6 bitmatrix at w=8 with the same API: P = XOR, and
+    Q block j = the GF(2^8) bit-matrix of a distinct low-weight constant
+    c_j (the cauchy_n_ones-minimal values). Distinct nonzero c_j make every
+    2-erasure pattern invertible. Flagged for re-verification against
+    liberation.c once the mount is available.
+    """
+    from ..gf.bitmatrix import value_to_bitmatrix
+
+    w = 8
+    if k > w:
+        raise ValueError("liber8tion requires k <= 8")
+    bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+    consts = _cbest_row(k, w)
+    for j in range(k):
+        for i in range(w):
+            bm[i, j * w + i] = 1
+        bm[w:2 * w, j * w:(j + 1) * w] = value_to_bitmatrix(consts[j], w)
+    return bm

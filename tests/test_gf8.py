@@ -1,0 +1,133 @@
+"""GF(2^w) core golden + property tests.
+
+Golden values are hand-computable facts of the 0x11D field (the same field
+jerasure's galois.c w=8 and ISA-L use), so they pin byte-exactness of the
+core without needing the reference binary.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import (
+    gf_mul, gf_div, gf_inv, gf_pow, gf8,
+    gf_matmul, gf_invert_matrix, gf_gaussian_inverse, is_invertible,
+    value_to_bitmatrix, matrix_to_bitmatrix, cauchy_n_ones,
+)
+
+
+def test_mul_golden_w8():
+    # x * x = x^2
+    assert gf_mul(2, 2) == 4
+    # 0x80 * 2 = 0x100 mod 0x11D = 0x1D
+    assert gf_mul(0x80, 2) == 0x1D
+    # known pairs in the 0x11D field: 2 * 142 = 0x11C ^ 0x11D = 1
+    assert gf_mul(2, 142) == 0x01
+    assert gf_inv(2) == 142
+    assert gf_mul(3, 7) == 9  # (x+1)(x^2+x+1) = x^3+1 -> 0b1001
+    assert gf_mul(0xFF, 0) == 0
+    assert gf_mul(1, 0xAB) == 0xAB
+
+
+def test_inverse_table_w8():
+    for a in range(1, 256):
+        assert gf_mul(a, gf_inv(a)) == 1
+    with pytest.raises(ZeroDivisionError):
+        gf_inv(0)
+
+
+def test_div_w8():
+    for a in (1, 2, 7, 255, 142):
+        for b in (1, 3, 9, 200):
+            assert gf_mul(gf_div(a, b), b) == a
+
+
+def test_generator_2_is_primitive():
+    # order of 2 must be 255 in the 0x11D field
+    seen = set()
+    x = 1
+    for _ in range(255):
+        seen.add(x)
+        x = gf_mul(x, 2)
+    assert x == 1
+    assert len(seen) == 255
+
+
+def test_other_widths():
+    # w=4 (poly 0x13), w=16 (0x1100B), w=32 (0x400007): inverses hold
+    for w in (4, 16):
+        n = (1 << w) - 1
+        for a in (1, 2, 3, min(7, n), n):
+            assert gf_mul(a, gf_inv(a, w), w) == 1
+    for a in (1, 2, 0xDEADBEEF, 0xFFFFFFFF):
+        assert gf_mul(a, gf_inv(a, 32), 32) == 1
+    # x^(2^w - 1) == 1 (field order)
+    assert gf_pow(2, (1 << 16) - 1, 16) == 1
+
+
+def test_numpy_tables_match_scalar():
+    g = gf8()
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, 512).astype(np.uint8)
+    b = rng.integers(0, 256, 512).astype(np.uint8)
+    got = g.mul(a, b)
+    want = np.array([gf_mul(int(x), int(y)) for x, y in zip(a, b)], dtype=np.uint8)
+    np.testing.assert_array_equal(got, want)
+    nz = a[a != 0]
+    np.testing.assert_array_equal(g.mul(nz, g.inv(nz)), np.ones_like(nz))
+
+
+def test_mul_const_region():
+    g = gf8()
+    rng = np.random.default_rng(1)
+    region = rng.integers(0, 256, 1024).astype(np.uint8)
+    for c in (0, 1, 2, 0x1D, 142, 255):
+        got = g.mul_const_region(c, region)
+        want = g.mul(np.uint8(c), region)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_matrix_inverse_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 4, 8):
+        while True:
+            m = rng.integers(0, 256, (n, n))
+            if is_invertible(m):
+                break
+        inv = gf_invert_matrix(m)
+        prod = gf_matmul(m, inv)
+        np.testing.assert_array_equal(prod, np.eye(n, dtype=np.int64))
+
+
+def test_singular_detected():
+    m = np.array([[1, 2], [1, 2]])
+    assert gf_gaussian_inverse(m) is None
+    assert not is_invertible(m)
+
+
+def test_bitmatrix_is_multiplication():
+    # B(e) applied to bit-vector of v == bits of e*v, for the jerasure
+    # column convention (column x = bits of e * 2^x).
+    rng = np.random.default_rng(3)
+    for _ in range(32):
+        e = int(rng.integers(0, 256))
+        v = int(rng.integers(0, 256))
+        B = value_to_bitmatrix(e, 8)
+        vbits = np.array([(v >> i) & 1 for i in range(8)], dtype=np.uint8)
+        got_bits = (B @ vbits) % 2
+        got = sum(int(b) << i for i, b in enumerate(got_bits))
+        assert got == gf_mul(e, v)
+
+
+def test_matrix_to_bitmatrix_layout():
+    mat = np.array([[1, 2], [3, 4]])
+    bm = matrix_to_bitmatrix(2, 2, 8, mat)
+    assert bm.shape == (16, 16)
+    np.testing.assert_array_equal(bm[0:8, 0:8], value_to_bitmatrix(1, 8))
+    np.testing.assert_array_equal(bm[8:16, 8:16], value_to_bitmatrix(4, 8))
+
+
+def test_cauchy_n_ones():
+    # identity bitmatrix for 1 -> exactly w ones
+    assert cauchy_n_ones(1, 8) == 8
+    # multiply-by-2 companion matrix in 0x11D: 7 shifted ones + popcount(0x1D)
+    assert cauchy_n_ones(2, 8) == 7 + 4
