@@ -1,0 +1,15 @@
+"""Erasure-code plugin framework (mirrors src/erasure-code/, SURVEY.md L2a/L2b).
+
+- ``interface``  — ErasureCodeInterface contract + ErasureCodeProfile
+                   (src/erasure-code/ErasureCodeInterface.h).
+- ``base``       — ErasureCode base class: padding, defaults
+                   (src/erasure-code/ErasureCode.{h,cc}).
+- ``registry``   — ErasureCodePluginRegistry + dynamic plugin loading
+                   (src/erasure-code/ErasureCodePlugin.{h,cc}).
+- ``plugins/``   — jerasure, isa, shec, clay, lrc, example equivalents,
+                   each TPU-native (JAX/XLA/Pallas compute paths).
+"""
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+from .base import ErasureCode
+from .registry import ErasureCodePluginRegistry, ErasureCodePlugin

@@ -1,0 +1,111 @@
+"""ErasureCodeInterface — the contract every plugin implements.
+
+Mirrors src/erasure-code/ErasureCodeInterface.h -> class ErasureCodeInterface
+(the Luminous..Quincy-era signature family per SURVEY.md §2.2: set<int> /
+map<int, bufferlist>, with minimum_to_decode returning per-chunk
+(offset, length) pairs so clay can express sub-chunk reads).
+
+Python mapping of the C++ types:
+- ErasureCodeProfile (map<string,string>)  -> dict[str, str]
+- set<int>                                 -> set[int]
+- map<int, bufferlist>                     -> dict[int, bytes]
+- the batched TPU path adds array variants  (encode_chunks_batch /
+  decode_chunks_batch over (batch, chunk, chunk_size) uint8 arrays) — the
+  reference has no analogue because its plugins process one stripe per
+  call; batching is the TPU framework's core performance primitive.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Tuple
+
+ErasureCodeProfile = Dict[str, str]
+
+SIMD_ALIGN = 64  # ErasureCode.h -> ErasureCode::SIMD_ALIGN (buffer alignment)
+
+
+class ErasureCodeInterface(abc.ABC):
+    """Abstract erasure code (ErasureCodeInterface.h -> ErasureCodeInterface)."""
+
+    @abc.abstractmethod
+    def init(self, profile: ErasureCodeProfile) -> None:
+        """Initialize from an erasure-code profile; raises on invalid.
+
+        C++ returns int + fills ostream; Python raises ValueError with the
+        message instead (init(profile, ss) -> init).
+        """
+
+    @abc.abstractmethod
+    def get_profile(self) -> ErasureCodeProfile:
+        ...
+
+    @abc.abstractmethod
+    def get_chunk_count(self) -> int:
+        """k + m."""
+
+    @abc.abstractmethod
+    def get_data_chunk_count(self) -> int:
+        """k."""
+
+    def get_coding_chunk_count(self) -> int:
+        """m."""
+        return self.get_chunk_count() - self.get_data_chunk_count()
+
+    def get_sub_chunk_count(self) -> int:
+        """Sub-chunks per chunk (1 except clay)."""
+        return 1
+
+    @abc.abstractmethod
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """Chunk size for an object of ``stripe_width`` bytes (with padding/alignment)."""
+
+    @abc.abstractmethod
+    def minimum_to_decode(
+        self, want_to_read: set, available: set,
+    ) -> Dict[int, List[Tuple[int, int]]]:
+        """Minimum chunks (with sub-chunk (offset, length) index ranges) to
+        read to decode ``want_to_read`` from ``available``.
+
+        Ranges are in sub-chunk index units (clay semantics); {c: [(0, 1)]}
+        means "all of chunk c" for sub_chunk_count == 1 codes.
+        Raises IOError if decoding is impossible.
+        """
+
+    def minimum_to_decode_with_cost(self, want_to_read: set,
+                                    available: Dict[int, int]) -> set:
+        """Given per-chunk costs, pick chunks to read (default: ignore cost)."""
+        return set(self.minimum_to_decode(want_to_read, set(available)).keys())
+
+    @abc.abstractmethod
+    def encode(self, want_to_encode: set, data: bytes) -> Dict[int, bytes]:
+        """Split + pad ``data`` into k chunks, compute m parity chunks,
+        return the requested subset."""
+
+    @abc.abstractmethod
+    def encode_chunks(self, want_to_encode: set,
+                      chunks: Dict[int, bytes]) -> Dict[int, bytes]:
+        """Compute coding chunks in-place given all k data chunks."""
+
+    @abc.abstractmethod
+    def decode(self, want_to_read: set, chunks: Dict[int, bytes],
+               chunk_size: int) -> Dict[int, bytes]:
+        """Reconstruct ``want_to_read`` from available ``chunks``."""
+
+    @abc.abstractmethod
+    def decode_chunks(self, want_to_read: set, chunks: Dict[int, bytes],
+                      decoded: Dict[int, bytes]) -> Dict[int, bytes]:
+        ...
+
+    def get_chunk_mapping(self) -> List[int]:
+        """Chunk index remapping (empty = identity)."""
+        return []
+
+    def decode_concat(self, chunks: Dict[int, bytes]) -> bytes:
+        """Decode all data chunks and concatenate (ErasureCodeInterface.h ->
+        decode_concat default)."""
+        k = self.get_data_chunk_count()
+        want = set(range(k))
+        chunk_size = len(next(iter(chunks.values())))
+        decoded = self.decode(want, chunks, chunk_size)
+        return b"".join(decoded[i] for i in range(k))

@@ -1,0 +1,5 @@
+"""Erasure-code plugins (mirrors src/erasure-code/{jerasure,isa,shec,clay,lrc}).
+
+Each module follows the __erasure_code_init__ contract documented in
+ceph_tpu.codes.registry (the dlopen/__erasure_code_init ABI equivalent).
+"""

@@ -1,0 +1,51 @@
+"""Example/fixture plugin: the didactic k=2, m=1 XOR code.
+
+Mirrors src/test/erasure-code/ErasureCodeExample.h +
+ErasureCodePluginExample.cc — the model of a minimal conforming plugin,
+used by the registry tests (SURVEY.md §4 "Fake/example backend").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import ErasureCode
+from ..registry import ERASURE_CODE_VERSION, ErasureCodePlugin
+
+__erasure_code_version__ = ERASURE_CODE_VERSION
+
+
+class ErasureCodeExample(ErasureCode):
+    """k=2 data chunks, 1 XOR parity chunk."""
+
+    def parse(self, profile) -> None:
+        self.k = 2
+        self.m = 1
+
+    def prepare(self) -> None:
+        pass
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        return -(-stripe_width // self.k)
+
+    def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
+        return (data[..., 0:1, :] ^ data[..., 1:2, :])
+
+    def decode_chunks_batch(self, chunks: np.ndarray, available: tuple,
+                            erased: tuple) -> np.ndarray:
+        if len(available) < 2:
+            raise IOError("need 2 chunks to decode")
+        # any two chunks XOR to the third
+        rec = chunks[..., 0, :] ^ chunks[..., 1, :]
+        return np.repeat(rec[..., None, :], len(erased), axis=-2)
+
+
+class ErasureCodePluginExample(ErasureCodePlugin):
+    def factory(self, profile, directory=None):
+        interface = ErasureCodeExample()
+        interface.init(profile)
+        return interface
+
+
+def __erasure_code_init__(plugin_name: str, registry) -> None:
+    registry.add(plugin_name, ErasureCodePluginExample())

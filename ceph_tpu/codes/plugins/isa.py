@@ -1,0 +1,90 @@
+"""isa-equivalent plugin (Intel ISA-L semantics), TPU-native compute.
+
+Mirrors src/erasure-code/isa/ErasureCodeIsa.{h,cc} +
+ErasureCodeIsaTableCache.{h,cc} + ErasureCodePluginIsa.cc:
+- class ErasureCodeIsaDefault — techniques reed_sol_van (gf_gen_rs_matrix)
+  and cauchy (gf_gen_cauchy1_matrix); w = 8 only.
+- decode builds the inverse of the survivor submatrix (gf_invert_matrix)
+  and re-encodes over survivors — same unique bytes as our shared path.
+- ErasureCodeIsaTableCache — per-(k, m, technique) matrix cache; here a
+  module-level lru_cache plays that role (the expensive part on TPU is
+  the traced/jitted kernel, which jax caches by static matrix).
+
+Profile: k, m, technique (default reed_sol_van). EC_ISA_ADDRESS_ALIGNMENT
+= 32 drives get_chunk_size (per-chunk alignment, unlike jerasure's
+per-object padding).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ...matrices.isal import gf_gen_cauchy1_matrix, gf_gen_rs_matrix
+from ..base import ErasureCode
+from ..techniques import MatrixCodeMixin
+from ..registry import ERASURE_CODE_VERSION, ErasureCodePlugin
+
+__erasure_code_version__ = ERASURE_CODE_VERSION
+
+EC_ISA_ADDRESS_ALIGNMENT = 32  # ErasureCodeIsa.h
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_coding_matrix(k: int, m: int, technique: str):
+    """ErasureCodeIsaTableCache equivalent: matrix per (k, m, technique)."""
+    if technique == "reed_sol_van":
+        full = gf_gen_rs_matrix(k + m, k)
+    else:
+        full = gf_gen_cauchy1_matrix(k + m, k)
+    return full[k:]
+
+
+class ErasureCodeIsa(MatrixCodeMixin, ErasureCode):
+    """ErasureCodeIsa.cc -> ErasureCodeIsaDefault (w = 8)."""
+
+    DEFAULT_K = "7"
+    DEFAULT_M = "3"
+    techniques = ("reed_sol_van", "cauchy")
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.technique = "reed_sol_van"
+        self.w = 8
+
+    def parse(self, profile) -> None:
+        self.k = self.to_int("k", profile, self.DEFAULT_K)
+        self.m = self.to_int("m", profile, self.DEFAULT_M)
+        self.technique = self.to_string("technique", profile, "reed_sol_van")
+        self.sanity_check_k_m(self.k, self.m)
+        if self.technique not in self.techniques:
+            raise ValueError(
+                f"technique={self.technique} is not a valid technique; "
+                f"choose one of {', '.join(self.techniques)}")
+        if self.k + self.m > 256:
+            raise ValueError(f"k+m={self.k + self.m} must be <= 256 (w=8)")
+
+    def build_matrix(self):
+        return _cached_coding_matrix(self.k, self.m, self.technique)
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """ErasureCodeIsa::get_chunk_size: per-chunk 32-byte alignment."""
+        chunk_size = -(-stripe_width // self.k)
+        modulo = chunk_size % EC_ISA_ADDRESS_ALIGNMENT
+        if modulo:
+            chunk_size += EC_ISA_ADDRESS_ALIGNMENT - modulo
+        return chunk_size
+
+
+class ErasureCodePluginIsa(ErasureCodePlugin):
+    """ErasureCodePluginIsa.cc -> factory."""
+
+    def factory(self, profile, directory=None):
+        interface = ErasureCodeIsa()
+        interface.init(profile)
+        return interface
+
+
+def __erasure_code_init__(plugin_name: str, registry) -> None:
+    registry.add(plugin_name, ErasureCodePluginIsa())

@@ -1,0 +1,109 @@
+"""ErasureCodePluginRegistry — plugin loading and factory.
+
+Mirrors src/erasure-code/ErasureCodePlugin.{h,cc}:
+- class ErasureCodePlugin (pure-virtual factory)      -> ErasureCodePlugin
+- class ErasureCodePluginRegistry: instance(), load(), add(), get(),
+  remove(), factory()                                 -> same names
+- dlopen("libec_<name>.so") + dlsym __erasure_code_init / version gate
+  -> importlib of ceph_tpu.codes.plugins.<name>, which must export
+  __erasure_code_version__ (string, checked against this build) and
+  __erasure_code_init__(plugin_name, registry) that registers itself.
+  The same contract is spoken over the C binary ABI by the bridge
+  (bridge/ — real dlopen .so for unmodified ceph consumers).
+
+Thread-safety: registry mutex like the reference (plugins_lock).
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Dict, Optional
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+
+# version-gate string (ErasureCodePlugin.h -> __erasure_code_version;
+# mismatched plugins are refused at load time)
+ERASURE_CODE_VERSION = "ceph_tpu 0.1"
+
+
+class ErasureCodePlugin:
+    """A loadable plugin: factory() yields configured code instances."""
+
+    def factory(self, profile: ErasureCodeProfile,
+                directory: Optional[str] = None) -> ErasureCodeInterface:
+        raise NotImplementedError
+
+
+class ErasureCodePluginRegistry:
+    """Singleton plugin registry (ErasureCodePlugin.cc -> instance())."""
+
+    _instance: Optional["ErasureCodePluginRegistry"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()  # held across load like plugins_lock
+        self._plugins: Dict[str, ErasureCodePlugin] = {}
+        self.disable_dlclose = True  # parity flag; no-op in-process
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        with self._lock:
+            if name in self._plugins:
+                raise KeyError(f"plugin {name} already registered")
+            self._plugins[name] = plugin
+
+    def get(self, name: str) -> Optional[ErasureCodePlugin]:
+        with self._lock:
+            return self._plugins.get(name)
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            del self._plugins[name]
+
+    def load(self, name: str, directory: Optional[str] = None) -> ErasureCodePlugin:
+        """Load plugin module ``name`` (dlopen + __erasure_code_init path).
+
+        ``directory`` overrides the python package to search (the
+        erasure_code_dir equivalent); default is ceph_tpu.codes.plugins.
+        """
+        with self._lock:  # whole load under the lock (ErasureCodePlugin.cc)
+            plugin = self._plugins.get(name)
+            if plugin is not None:
+                return plugin
+            pkg = directory or "ceph_tpu.codes.plugins"
+            try:
+                module = importlib.import_module(f"{pkg}.{name}")
+            except ImportError as e:
+                raise IOError(
+                    f"load dlopen({pkg}.{name}): {e}") from e
+            version = getattr(module, "__erasure_code_version__", None)
+            if version is None:
+                raise IOError(
+                    f"load dlsym({name}, __erasure_code_version__): not found")
+            if version != ERASURE_CODE_VERSION:
+                raise IOError(
+                    f"erasure_code_init({name}): plugin version {version!r} "
+                    f"!= expected {ERASURE_CODE_VERSION!r}")
+            init = getattr(module, "__erasure_code_init__", None)
+            if init is None:
+                raise IOError(
+                    f"load dlsym({name}, __erasure_code_init__): not found")
+            init(name, self)
+            plugin = self._plugins.get(name)
+            if plugin is None:
+                raise IOError(
+                    f"erasure_code_init({name}) did not register the plugin")
+            return plugin
+
+    def factory(self, plugin_name: str, profile: ErasureCodeProfile,
+                directory: Optional[str] = None) -> ErasureCodeInterface:
+        """Load (if needed) and instantiate a configured erasure code."""
+        plugin = self.load(plugin_name, directory)
+        return plugin.factory(profile, directory)

@@ -1,0 +1,128 @@
+"""Shared compute machinery for matrix / bitmatrix erasure codes.
+
+Factored out of the jerasure and isa plugins (both reference plugins use
+the same underlying jerasure/gf-complete region ops; here both use the
+same numpy/XLA paths):
+
+- MatrixCodeMixin    — GF(2^w)-element matrix codes (reed_sol_van,
+  reed_sol_r6_op, isa reed_sol_van/cauchy). Encode/decode = word-wise
+  GF(2^w) matrix application (jerasure_matrix_encode/decode semantics).
+- BitmatrixCodeMixin — GF(2) bitmatrix codes in jerasure packet layout
+  (cauchy_*, liberation, blaum_roth, liber8tion, shec).
+
+Path selection: below ``min_xla_bytes`` the numpy reference region ops run
+(no trace/compile cost); above it, the jit XLA path. Both are byte-
+identical and cross-pinned in tests.
+
+Decode-matrix caches are per-instance (reset by prepare()), mirroring
+ErasureCodeIsaTableCache's role without pinning instances in a global.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import regionops
+from ..ops.xla_ops import (
+    apply_bitmatrix_xla,
+    apply_matrix_xla,
+    bitmatrix_to_static,
+    matrix_to_static,
+)
+
+
+class MatrixCodeMixin:
+    """Compute paths for GF(2^w)-element matrix codes.
+
+    Requires: self.k, self.m, self.w, and build_matrix() -> (m, k) matrix.
+    """
+
+    min_xla_bytes = 1 << 20
+
+    def build_matrix(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def prepare(self) -> None:
+        self.matrix = self.build_matrix()
+        self._matrix_static = matrix_to_static(self.matrix)
+        self._decode_cache: dict = {}
+
+    def _apply(self, chunks: np.ndarray, matrix: np.ndarray,
+               matrix_static) -> np.ndarray:
+        words = regionops.words_view(np.ascontiguousarray(chunks), self.w)
+        if chunks.nbytes < self.min_xla_bytes:
+            return regionops.matrix_encode(words, matrix, self.w).view(np.uint8)
+        return np.asarray(
+            apply_matrix_xla(words, matrix_static, self.w)).view(np.uint8)
+
+    def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
+        return self._apply(data, self.matrix, self._matrix_static)
+
+    def _decode_matrix(self, available: tuple, erased: tuple):
+        key = (available, erased)
+        hit = self._decode_cache.get(key)
+        if hit is None:
+            survivors = list(available[:self.k])
+            dm = regionops.matrix_decode_matrix(
+                self.matrix, self.k, survivors, list(erased), self.w)
+            hit = (dm, matrix_to_static(dm), len(survivors))
+            self._decode_cache[key] = hit
+        return hit
+
+    def decode_chunks_batch(self, chunks: np.ndarray, available: tuple,
+                            erased: tuple) -> np.ndarray:
+        if len(available) < self.k:
+            raise IOError(f"need {self.k} chunks, have {len(available)}")
+        dm, dm_static, ns = self._decode_matrix(tuple(available), tuple(erased))
+        return self._apply(np.ascontiguousarray(chunks[..., :ns, :]), dm,
+                           dm_static)
+
+
+class BitmatrixCodeMixin:
+    """Compute paths for GF(2) bitmatrix codes in jerasure packet layout.
+
+    Requires: self.k, self.m, self.w, self.packetsize, and
+    build_bitmatrix() -> (m*w, k*w) 0/1 matrix.
+    """
+
+    min_xla_bytes = 1 << 20
+
+    def build_bitmatrix(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def prepare(self) -> None:
+        self.bitmatrix = self.build_bitmatrix()
+        self._bitmatrix_static = bitmatrix_to_static(self.bitmatrix)
+        self._decode_cache: dict = {}
+
+    def _apply(self, chunks: np.ndarray, bitmatrix: np.ndarray,
+               bitmatrix_static) -> np.ndarray:
+        if chunks.nbytes < self.min_xla_bytes:
+            return regionops.bitmatrix_encode(chunks, bitmatrix, self.w,
+                                              self.packetsize)
+        return np.asarray(apply_bitmatrix_xla(
+            chunks, bitmatrix_static, self.w, self.packetsize))
+
+    def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
+        return self._apply(np.ascontiguousarray(data), self.bitmatrix,
+                           self._bitmatrix_static)
+
+    def _decode_bitmatrix(self, available: tuple, erased: tuple):
+        key = (available, erased)
+        hit = self._decode_cache.get(key)
+        if hit is None:
+            survivors = list(available[:self.k])
+            dm = regionops.bitmatrix_decode_matrix(
+                self.bitmatrix, self.k, self.w, survivors, list(erased))
+            hit = (dm, bitmatrix_to_static(dm), len(survivors))
+            self._decode_cache[key] = hit
+        return hit
+
+    def decode_chunks_batch(self, chunks: np.ndarray, available: tuple,
+                            erased: tuple) -> np.ndarray:
+        if len(available) < self.k:
+            raise IOError(f"need {self.k} chunks, have {len(available)}")
+        dm, dm_static, ns = self._decode_bitmatrix(tuple(available),
+                                                   tuple(erased))
+        return self._apply(np.ascontiguousarray(chunks[..., :ns, :]), dm,
+                           dm_static)

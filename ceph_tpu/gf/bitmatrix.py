@@ -1,4 +1,4 @@
-"""GF(2^w) value <-> w x w GF(2) bit-matrix transforms.
+"""GF(2^w) value <-> w x w GF(2) bit-matrix transforms + GF(2) linear algebra.
 
 Replicates jerasure's bit-matrix machinery (SURVEY.md §2.1 "jerasure
 (vendored)"):
@@ -8,6 +8,8 @@ Replicates jerasure's bit-matrix machinery (SURVEY.md §2.1 "jerasure
 - jerasure/src/cauchy.c -> cauchy_n_ones: number of ones in the bit-matrix
   of a value (used by cauchy_good_general_coding_matrix to pick the
   lightest-weight row scaling).
+- jerasure/src/jerasure.c -> jerasure_invert_bitmatrix: GF(2) inversion
+  for bitmatrix decode (gf2_invert / gf2_rank below).
 
 The bit-matrix form is also the TPU-native representation: multiplying by a
 constant becomes w XOR-accumulated bit-plane selections, i.e. a GF(2) matmul
@@ -18,7 +20,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .gf8 import gf_mul
+from .gf8 import DEFAULT_POLY, gf_mul
 
 
 def value_to_bitmatrix(e: int, w: int = 8, poly: int | None = None) -> np.ndarray:
@@ -65,26 +67,80 @@ def bitmatrix_n_ones(e: int, w: int = 8, poly: int | None = None) -> int:
 cauchy_n_ones = bitmatrix_n_ones
 
 
-def gf2_rank(mat: np.ndarray) -> int:
-    """Rank of a 0/1 matrix over GF(2) (bit-packed row elimination).
+def cauchy_n_ones_all(w: int) -> np.ndarray:
+    """cauchy_n_ones for every field value at once (vectorized).
 
-    Used by bitmatrix decode paths to pick invertible survivor sets, the
-    role jerasure_invert_bitmatrix plays for jerasure_bitmatrix_decode.
+    out[v] = bitmatrix ones of v, for v in [0, 2^w). Used to rank RAID-6
+    row candidates (the cbest enumeration) without 2^w scalar GF calls.
     """
-    a = [int("".join(str(int(b)) for b in row), 2)
-         for row in np.asarray(mat) % 2]
+    dtype = {4: np.uint8, 8: np.uint8, 16: np.uint16}.get(w, np.uint32)
+    mask = (1 << w) - 1
+    fb = DEFAULT_POLY[w] & mask
+    v = np.arange(1 << w, dtype=np.uint64)
+    total = np.zeros(1 << w, dtype=np.int64)
+    for _ in range(w):
+        # popcount via byte table on the raw bytes
+        total += np.unpackbits(
+            v.view(np.uint8).reshape(-1, 8), axis=1).sum(axis=1, dtype=np.int64)
+        hi = (v >> np.uint64(w - 1)) & np.uint64(1)
+        v = ((v << np.uint64(1)) & np.uint64(mask)) ^ (hi * np.uint64(fb))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# GF(2) linear algebra (bit-packed rows, LSB = column 0)
+# ---------------------------------------------------------------------------
+
+def _pack_rows(mat: np.ndarray) -> list[int]:
+    """Each 0/1 row -> int with bit j (LSB-first) = column j."""
+    m = np.asarray(mat) % 2
+    ncols = m.shape[1]
+    weights = (1 << np.arange(ncols, dtype=object))
+    return [int((row.astype(object) * weights).sum()) for row in m]
+
+
+def _eliminate(rows: list[int], ncols: int) -> int:
+    """In-place Gauss-Jordan over GF(2); returns rank."""
     rank = 0
-    for col in range(np.asarray(mat).shape[1] - 1, -1, -1):
+    for col in range(ncols):
         piv = None
-        for i in range(rank, len(a)):
-            if (a[i] >> col) & 1:
+        for i in range(rank, len(rows)):
+            if (rows[i] >> col) & 1:
                 piv = i
                 break
         if piv is None:
             continue
-        a[rank], a[piv] = a[piv], a[rank]
-        for i in range(len(a)):
-            if i != rank and (a[i] >> col) & 1:
-                a[i] ^= a[rank]
+        rows[rank], rows[piv] = rows[piv], rows[rank]
+        for i in range(len(rows)):
+            if i != rank and (rows[i] >> col) & 1:
+                rows[i] ^= rows[rank]
         rank += 1
     return rank
+
+
+def gf2_invert(mat: np.ndarray) -> np.ndarray | None:
+    """Invert a square 0/1 matrix over GF(2); None if singular.
+
+    The bitmatrix-technique decode path's equivalent of
+    jerasure_invert_bitmatrix (used by jerasure_schedule_decode_lazy).
+    """
+    m = np.asarray(mat) % 2
+    n = m.shape[0]
+    if m.shape != (n, n):
+        raise ValueError("square matrix required")
+    # augment with identity above bit n
+    rows = [r | (1 << (n + i)) for i, r in enumerate(_pack_rows(m))]
+    if _eliminate(rows, n) != n:
+        return None
+    out = np.zeros((n, n), dtype=np.uint8)
+    for i in range(n):
+        inv = rows[i] >> n
+        for j in range(n):
+            out[i, j] = (inv >> j) & 1
+    return out
+
+
+def gf2_rank(mat: np.ndarray) -> int:
+    """Rank of a 0/1 matrix over GF(2)."""
+    m = np.asarray(mat)
+    return _eliminate(_pack_rows(m), m.shape[1])

@@ -173,8 +173,10 @@ def cauchy_improve_coding_matrix(k: int, m: int, w: int, matrix: np.ndarray) -> 
 @functools.lru_cache(maxsize=8)
 def _cbest_values(w: int) -> tuple[int, ...]:
     """All nonzero field values sorted by (cauchy_n_ones, value)."""
-    return tuple(sorted(range(1, 1 << w),
-                        key=lambda v: (cauchy_n_ones(v, w), v)))
+    from ..gf.bitmatrix import cauchy_n_ones_all
+    ones = cauchy_n_ones_all(w)
+    vals = np.argsort(ones[1:], kind="stable") + 1  # ties broken by value
+    return tuple(int(v) for v in vals)
 
 
 def _cbest_row(k: int, w: int) -> list[int]:

@@ -1,0 +1,22 @@
+"""Batched encode/decode compute paths.
+
+Three tiers, all byte-identical:
+- ``regionops``  — numpy host reference (plays the role gf-complete's
+                   region ops play for jerasure: the ground truth).
+- ``xla_ops``    — jit-compiled JAX paths built from XOR/shift chains
+                   (no gathers; TPU- and CPU-safe).
+- ``pallas_gf``  — Pallas bit-plane MXU kernels (the performance path).
+"""
+
+from .regionops import (
+    matrix_encode,
+    matrix_decode_matrix,
+    bitmatrix_encode,
+    bitmatrix_decode_matrix,
+)
+from .xla_ops import (
+    encode_matrix_xla,
+    apply_matrix_xla,
+    encode_bitmatrix_xla,
+    apply_bitmatrix_xla,
+)

@@ -1,0 +1,168 @@
+"""Host (numpy) reference implementations of the region operations.
+
+These are the ground truth the JAX/Pallas paths are tested against, and the
+byte-level contract with the reference:
+
+- Matrix (GF(2^8)-element) coding — jerasure/src/jerasure.c ->
+  jerasure_matrix_encode / jerasure_matrix_decode and ISA-L ec_encode_data:
+  parity chunk i = XOR_j ( M[i,j] * data_j ) with * the GF(2^8) product
+  applied byte-wise to whole chunks.
+
+- Bitmatrix coding — jerasure/src/jerasure.c -> jerasure_bitmatrix_encode /
+  jerasure_schedule_encode: each chunk is a sequence of blocks of
+  w * packetsize bytes; packet l of a block carries "bit l" of a GF(2^w)
+  element whose coefficients are packet-sized byte regions. Parity packet
+  row r = XOR of the data packets selected by bitmatrix row r. Used by the
+  cauchy_*/liberation/blaum_roth/liber8tion techniques (and shec), whose
+  on-disk bytes are defined by this packet layout, NOT by byte-wise GF
+  multiplication.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..gf.gf8 import DEFAULT_POLY, gf8
+from ..gf.matrix import gf_invert_matrix, gf_matmul
+from ..gf.bitmatrix import gf2_invert
+
+# word dtype for each width (regions are arrays of w-bit little-endian
+# words, matching jerasure's galois_wNN_region_multiply view of memory)
+WORD_DTYPE = {8: np.uint8, 16: np.uint16, 32: np.uint32}
+
+
+def words_view(chunk_bytes: np.ndarray, w: int) -> np.ndarray:
+    """Byte region -> w-bit word view (little-endian, like x86/TPU hosts)."""
+    return np.ascontiguousarray(chunk_bytes).view(WORD_DTYPE[w])
+
+
+def mul_const_region(c: int, region: np.ndarray, w: int = 8) -> np.ndarray:
+    """region * constant in GF(2^w); region is an array of w-bit words.
+
+    w=8 uses the 64 KiB product table; w=16/32 use a vectorized doubling
+    (xtime) chain — both bit-identical to gf-complete's region ops.
+    """
+    if w == 8:
+        return gf8().mul_table[int(c)][np.asarray(region, dtype=np.uint8)]
+    dtype = WORD_DTYPE[w]
+    region = np.asarray(region, dtype=dtype)
+    poly_feedback = dtype(DEFAULT_POLY[w] & ((1 << w) - 1))
+    acc = np.zeros_like(region)
+    v = region
+    cc = int(c)
+    while cc:
+        if cc & 1:
+            acc = acc ^ v
+        cc >>= 1
+        if cc:
+            hi = v >> dtype(w - 1)
+            v = ((v << dtype(1)) & dtype((1 << w) - 1)) ^ (hi * poly_feedback)
+            v = v.astype(dtype)
+    return acc
+
+
+def matrix_encode(data: np.ndarray, matrix: np.ndarray, w: int = 8) -> np.ndarray:
+    """Apply an (r, k) GF(2^w) matrix to (..., k, C) word chunks -> (..., r, C).
+
+    ``data`` is in w-bit words (see words_view); for w=8 plain uint8 bytes.
+    """
+    data = np.asarray(data, dtype=WORD_DTYPE[w])
+    matrix = np.asarray(matrix)
+    r, k = matrix.shape
+    assert data.shape[-2] == k, (data.shape, matrix.shape)
+    out = np.zeros(data.shape[:-2] + (r, data.shape[-1]), dtype=WORD_DTYPE[w])
+    for i in range(r):
+        acc = out[..., i, :]
+        for j in range(k):
+            c = int(matrix[i, j])
+            if c == 0:
+                continue
+            acc ^= mul_const_region(c, data[..., j, :], w)
+        out[..., i, :] = acc
+    return out
+
+
+def matrix_decode_matrix(matrix: np.ndarray, k: int, survivors: list[int],
+                         want: list[int], w: int = 8) -> np.ndarray:
+    """Build the (len(want), k) matrix mapping survivor chunks -> wanted chunks.
+
+    ``matrix`` is the (m, k) coding matrix; the full generator is
+    [I_k ; matrix]. ``survivors`` are the k chunk ids used for decode (in
+    the order their chunks will be stacked); ``want`` lists wanted chunk
+    ids (data or coding). Same math as jerasure_matrix_decode: invert the
+    survivor submatrix, then compose coding rows for erased parity.
+    """
+    matrix = np.asarray(matrix)
+    m = matrix.shape[0]
+    full = np.vstack([np.eye(k, dtype=np.int64), matrix])
+    assert len(survivors) == k
+    sub = full[list(survivors)]
+    inv = gf_invert_matrix(sub, w)  # data = inv @ survivor_chunks
+    rows = []
+    for t in want:
+        if t < k:
+            rows.append(inv[t])
+        else:
+            rows.append(gf_matmul(matrix[t - k:t - k + 1], inv, w)[0])
+    return np.array(rows, dtype=np.int64)
+
+
+def _bit_view(chunks: np.ndarray, w: int, packetsize: int) -> np.ndarray:
+    """(..., n, C) -> (..., n, nb, w, p) packet view (no copy)."""
+    c = chunks.shape[-1]
+    assert c % (w * packetsize) == 0, (
+        f"chunk size {c} not a multiple of w*packetsize = {w * packetsize}")
+    nb = c // (w * packetsize)
+    return chunks.reshape(chunks.shape[:-1] + (nb, w, packetsize))
+
+
+def bitmatrix_encode(data: np.ndarray, bitmatrix: np.ndarray, w: int,
+                     packetsize: int) -> np.ndarray:
+    """Apply an (r*w, k*w) GF(2) bitmatrix to (..., k, C) chunks -> (..., r, C).
+
+    jerasure_bitmatrix_encode packet layout: chunk = blocks of w packets of
+    ``packetsize`` bytes each.
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    bitmatrix = np.asarray(bitmatrix)
+    rw, kw = bitmatrix.shape
+    assert kw % w == 0 and rw % w == 0
+    k = kw // w
+    r = rw // w
+    assert data.shape[-2] == k
+    dv = _bit_view(data, w, packetsize)  # (..., k, nb, w, p)
+    out = np.zeros(data.shape[:-2] + (r, data.shape[-1]), dtype=np.uint8)
+    ov = _bit_view(out, w, packetsize)
+    for row in range(rw):
+        i, l = divmod(row, w)
+        acc = ov[..., i, :, l, :]
+        for col in np.nonzero(bitmatrix[row])[0]:
+            j, lb = divmod(int(col), w)
+            acc ^= dv[..., j, :, lb, :]
+        ov[..., i, :, l, :] = acc
+    return out
+
+
+def bitmatrix_decode_matrix(bitmatrix: np.ndarray, k: int, w: int,
+                            survivors: list[int], want: list[int]) -> np.ndarray:
+    """(len(want)*w, k*w) GF(2) matrix mapping survivor chunks -> wanted chunks.
+
+    Bit-level analogue of matrix_decode_matrix; the role of
+    jerasure_schedule_decode_lazy's inverted bitmatrix.
+    """
+    bitmatrix = np.asarray(bitmatrix)
+    mw, kw = bitmatrix.shape
+    assert kw == k * w
+    full = np.vstack([np.eye(kw, dtype=np.uint8), bitmatrix])
+    sub = np.vstack([full[s * w:(s + 1) * w] for s in survivors])
+    inv = gf2_invert(sub)
+    if inv is None:
+        raise np.linalg.LinAlgError("survivor bitmatrix is singular")
+    rows = []
+    for t in want:
+        if t < k:
+            rows.append(inv[t * w:(t + 1) * w])
+        else:
+            coding = bitmatrix[(t - k) * w:(t - k + 1) * w]
+            rows.append((coding.astype(np.int64) @ inv.astype(np.int64)) % 2)
+    return np.vstack(rows).astype(np.uint8)

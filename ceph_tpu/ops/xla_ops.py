@@ -1,0 +1,134 @@
+"""JAX/XLA batched encode/decode paths (gather-free, TPU-safe).
+
+TPU-first design notes (SURVEY.md §7 step 3):
+- No byte gathers (TPUs have none): GF(2^8) constant multiplication is an
+  unrolled xtime (multiply-by-x) chain — at most 8 shift/mask/xor vector
+  ops per doubling, shared across all matrix rows that consume the same
+  data chunk. XLA fuses the chains into the XOR reduction.
+- Matrices are STATIC (hashable tuples) — each (matrix, shape) pair traces
+  once; erasure patterns are few (<= C(k+m, m)) so decode recompiles are
+  bounded and cached.
+- Everything is batch-first: (batch, chunks, chunk_size) uint8 in HBM.
+  Batching many stripes per call is the whole PCIe/HBM amortization story.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def matrix_to_static(matrix) -> tuple[tuple[int, ...], ...]:
+    """Numpy (r, k) matrix -> hashable tuple-of-tuples for jit static args."""
+    return tuple(tuple(int(x) for x in row) for row in np.asarray(matrix))
+
+
+def bitmatrix_to_static(bitmatrix) -> tuple[int, ...]:
+    """Numpy (rw, kw) 0/1 matrix -> tuple of per-row column bitmasks."""
+    bm = np.asarray(bitmatrix)
+    return tuple(int("".join(str(int(b)) for b in row[::-1]), 2) for row in bm)
+
+
+from ..gf.gf8 import DEFAULT_POLY
+
+_JNP_DTYPE = {8: jnp.uint8, 16: jnp.uint16, 32: jnp.uint32}
+
+
+def _xtime(v: jax.Array, w: int = 8) -> jax.Array:
+    """Multiply a w-bit word array by x: (v<<1) ^ (poly_low if MSB set)."""
+    dt = _JNP_DTYPE[w]
+    fb = dt(DEFAULT_POLY[w] & ((1 << w) - 1))
+    hi = v >> dt(w - 1)
+    return ((v << dt(1)) ^ (hi * fb)).astype(dt)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def apply_matrix_xla(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
+    """Apply static (r, s) GF(2^w) matrix to (..., s, C) words -> (..., r, C).
+
+    Equivalent of jerasure_matrix_encode / ISA-L ec_encode_data on a batch;
+    ``chunks`` dtype must be the w-bit word dtype (uint8/uint16/uint32).
+    """
+    r = len(matrix_t)
+    s = len(matrix_t[0])
+    assert chunks.shape[-2] == s
+    # shared doubling planes per input chunk; XLA dead-code-eliminates
+    # planes no matrix entry uses.
+    planes = []
+    for j in range(s):
+        v = chunks[..., j, :]
+        pj = [v]
+        for _ in range(w - 1):
+            v = _xtime(v, w)
+            pj.append(v)
+        planes.append(pj)
+    outs = []
+    for i in range(r):
+        acc = None
+        for j in range(s):
+            c = matrix_t[i][j]
+            t = 0
+            while c:
+                if c & 1:
+                    p = planes[j][t]
+                    acc = p if acc is None else acc ^ p
+                c >>= 1
+                t += 1
+        if acc is None:
+            acc = jnp.zeros_like(chunks[..., 0, :])
+        outs.append(acc)
+    return jnp.stack(outs, axis=-2)
+
+
+def encode_matrix_xla(data: jax.Array, matrix, w: int = 8) -> jax.Array:
+    """Convenience: numpy matrix in, parity (..., m, C) out."""
+    return apply_matrix_xla(data, matrix_to_static(matrix), w)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def apply_bitmatrix_xla(chunks: jax.Array, bitmatrix_rows, w: int,
+                        packetsize: int) -> jax.Array:
+    """Apply a static GF(2) bitmatrix in jerasure packet layout.
+
+    chunks: (..., s, C) uint8 with C % (w*packetsize) == 0.
+    bitmatrix_rows: tuple of r*w ints; bit (j*w + lb) of row (i*w + l) set
+    means parity packet (i, l) XORs data packet (j, lb).
+    Returns (..., r, C).
+    """
+    s = chunks.shape[-2]
+    c = chunks.shape[-1]
+    rw = len(bitmatrix_rows)
+    assert rw % w == 0
+    r = rw // w
+    assert c % (w * packetsize) == 0, (c, w, packetsize)
+    nb = c // (w * packetsize)
+    dv = chunks.reshape(chunks.shape[:-2] + (s, nb, w, packetsize))
+    out_rows = []
+    for row_idx in range(rw):
+        mask = bitmatrix_rows[row_idx]
+        acc = None
+        col = 0
+        while mask:
+            if mask & 1:
+                j, lb = divmod(col, w)
+                p = dv[..., j, :, lb, :]
+                acc = p if acc is None else acc ^ p
+            mask >>= 1
+            col += 1
+        if acc is None:
+            acc = jnp.zeros(chunks.shape[:-2] + (nb, packetsize), jnp.uint8)
+        out_rows.append(acc)
+    # out_rows[i*w + l] has shape (..., nb, p); assemble to (..., r, C)
+    stacked = jnp.stack(out_rows, axis=-3)  # (..., rw, nb, p)
+    stacked = stacked.reshape(stacked.shape[:-3] + (r, w, nb, packetsize))
+    stacked = jnp.swapaxes(stacked, -3, -2)  # (..., r, nb, w, p)
+    return stacked.reshape(stacked.shape[:-4] + (r, c))
+
+
+def encode_bitmatrix_xla(data: jax.Array, bitmatrix, w: int,
+                         packetsize: int) -> jax.Array:
+    return apply_bitmatrix_xla(data, bitmatrix_to_static(bitmatrix), w,
+                               packetsize)

@@ -2,13 +2,23 @@
 
 Tests must run without TPU hardware; multi-chip sharding is validated on a
 virtual CPU mesh (the driver separately dry-runs the multichip path, see
-__graft_entry__.py). Must run before jax is imported anywhere.
+__graft_entry__.py).
+
+The env var alone is NOT enough here: the machine's sitecustomize imports
+jax at interpreter startup with JAX_PLATFORMS=axon already exported, so
+jax's config captured "axon" before this file runs. jax.config.update
+re-selects the platform as long as no backend has been initialized yet —
+which holds at conftest import time.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,234 @@
+"""Plugin framework round-trip tests.
+
+Models the reference's per-plugin gtest strategy (SURVEY.md §4): random
+data -> encode -> erase up to m chunks (exhaustively) -> minimum_to_decode
+-> decode -> byte-compare. Also cross-checks the numpy reference region ops
+against the XLA batched path byte-for-byte.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+
+
+def registry():
+    return ErasureCodePluginRegistry.instance()
+
+
+def roundtrip(ec, data: bytes, erase: tuple) -> None:
+    n = ec.get_chunk_count()
+    k = ec.get_data_chunk_count()
+    encoded = ec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    assert set(encoded) == set(range(n))
+
+    available = {i: encoded[i] for i in range(n) if i not in erase}
+    want = set(range(k))
+    minimum = ec.minimum_to_decode(want, set(available))
+    assert set(minimum) <= set(available)
+    use = {i: available[i] for i in minimum}
+    decoded = ec.decode(want, use, chunk_size)
+    got = b"".join(decoded[i] for i in range(k))
+    assert got[:len(data)] == data, f"roundtrip failed for erasures {erase}"
+
+
+JERASURE_PROFILES = [
+    {"technique": "reed_sol_van", "k": "4", "m": "2"},
+    {"technique": "reed_sol_van", "k": "8", "m": "3"},
+    {"technique": "reed_sol_van", "k": "4", "m": "2", "w": "16"},
+    {"technique": "reed_sol_van", "k": "3", "m": "2", "w": "32"},
+    {"technique": "reed_sol_r6_op", "k": "4", "m": "2"},
+    {"technique": "cauchy_orig", "k": "4", "m": "2", "packetsize": "8"},
+    {"technique": "cauchy_good", "k": "8", "m": "3", "packetsize": "8"},
+    {"technique": "cauchy_good", "k": "4", "m": "2", "w": "4", "packetsize": "8"},
+    {"technique": "liberation", "k": "4", "m": "2", "w": "7", "packetsize": "8"},
+    {"technique": "blaum_roth", "k": "4", "m": "2", "w": "6", "packetsize": "8"},
+    {"technique": "liber8tion", "k": "4", "m": "2", "packetsize": "8"},
+]
+
+
+@pytest.mark.parametrize("profile", JERASURE_PROFILES,
+                         ids=lambda p: "-".join(f"{k}={v}" for k, v in p.items()))
+def test_jerasure_roundtrip_exhaustive(profile):
+    ec = registry().factory("jerasure", dict(profile))
+    k, m = ec.k, ec.m
+    n = k + m
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, 3000).astype(np.uint8).tobytes()
+    # exhaustive over all erasure patterns up to m erasures
+    for nerase in range(m + 1):
+        for erase in itertools.combinations(range(n), nerase):
+            roundtrip(ec, data, erase)
+
+
+@pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy"])
+def test_isa_roundtrip_exhaustive(technique):
+    ec = registry().factory("isa", {"technique": technique, "k": "8", "m": "3"})
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 256, 4096).astype(np.uint8).tobytes()
+    for nerase in range(4):
+        for erase in itertools.combinations(range(11), nerase):
+            roundtrip(ec, data, erase)
+
+
+def test_example_roundtrip():
+    ec = registry().factory("example", {})
+    data = b"0123456789abcdef-ceph-tpu"
+    for erase in [(), (0,), (1,), (2,)]:
+        roundtrip(ec, data, erase)
+
+
+def test_encode_decode_coding_chunk_reconstruction():
+    # erased coding chunks must also be reconstructible (want includes parity)
+    ec = registry().factory("jerasure",
+                            {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    data = bytes(range(256)) * 4
+    encoded = ec.encode(set(range(6)), data)
+    cs = len(encoded[0])
+    available = {i: encoded[i] for i in (0, 1, 2, 3)}  # both parity lost
+    decoded = ec.decode({4, 5}, available, cs)
+    assert decoded[4] == encoded[4]
+    assert decoded[5] == encoded[5]
+
+
+def test_batch_encode_matches_scalar():
+    # the batched TPU path and the per-stripe byte path must agree
+    for profile in ({"technique": "reed_sol_van", "k": "4", "m": "2"},
+                    {"technique": "cauchy_good", "k": "4", "m": "2",
+                     "packetsize": "8"}):
+        ec = registry().factory("jerasure", dict(profile))
+        cs = ec.get_chunk_size(4096)
+        rng = np.random.default_rng(9)
+        batch = rng.integers(0, 256, (5, ec.k, cs)).astype(np.uint8)
+        parity = ec.encode_chunks_batch(batch)
+        assert parity.shape == (5, ec.m, cs)
+        for b in range(5):
+            chunks = {i: batch[b, i].tobytes() for i in range(ec.k)}
+            out = ec.encode_chunks(set(range(ec.k + ec.m)), chunks)
+            for i in range(ec.m):
+                assert out[ec.k + i] == parity[b, i].tobytes()
+
+
+def test_xla_matches_numpy_reference():
+    # XLA path (forced) vs numpy regionops ground truth, encode + decode
+    from ceph_tpu.ops import regionops
+    ec = registry().factory("jerasure",
+                            {"technique": "reed_sol_van", "k": "6", "m": "3"})
+    ec.min_xla_bytes = 0  # force XLA
+    cs = ec.get_chunk_size(6 * 512)
+    rng = np.random.default_rng(10)
+    batch = rng.integers(0, 256, (3, 6, cs)).astype(np.uint8)
+    want = ec.encode_chunks_batch(batch)
+    ref = regionops.matrix_encode(batch, ec.matrix, 8)
+    np.testing.assert_array_equal(want, ref)
+    # decode through XLA: erase data chunks 1 and 4
+    available = (0, 2, 3, 5, 6, 7)
+    full = np.concatenate([batch, ref], axis=1)
+    rec = ec.decode_chunks_batch(full[:, list(available)], available, (1, 4))
+    np.testing.assert_array_equal(rec[:, 0], batch[:, 1])
+    np.testing.assert_array_equal(rec[:, 1], batch[:, 4])
+
+    ecb = registry().factory("jerasure", {"technique": "cauchy_good", "k": "6",
+                                          "m": "3", "packetsize": "8"})
+    ecb.min_xla_bytes = 0
+    csb = ecb.get_chunk_size(6 * 8 * 8 * 4)
+    batch = rng.integers(0, 256, (3, 6, csb)).astype(np.uint8)
+    want = ecb.encode_chunks_batch(batch)
+    ref = regionops.bitmatrix_encode(batch, ecb.bitmatrix, ecb.w, ecb.packetsize)
+    np.testing.assert_array_equal(want, ref)
+
+
+def test_xla_matches_numpy_w16_w32():
+    for w, k, m in ((16, 4, 2), (32, 3, 2)):
+        ec = registry().factory("jerasure", {"technique": "reed_sol_van",
+                                             "k": str(k), "m": str(m),
+                                             "w": str(w)})
+        ec.min_xla_bytes = 0
+        cs = ec.get_chunk_size(k * 256)
+        rng = np.random.default_rng(w)
+        batch = rng.integers(0, 256, (2, k, cs)).astype(np.uint8)
+        want = ec.encode_chunks_batch(batch)
+        from ceph_tpu.ops import regionops
+        words = regionops.words_view(batch, w)
+        ref = regionops.matrix_encode(words, ec.matrix, w).view(np.uint8)
+        np.testing.assert_array_equal(want, ref)
+
+
+def test_chunk_size_alignment():
+    # jerasure reed_sol_van w=8: alignment = k*w*4 (w*4 % 16 == 0)
+    ec = registry().factory("jerasure",
+                            {"technique": "reed_sol_van", "k": "8", "m": "3"})
+    assert ec.get_alignment() == 8 * 8 * 4
+    cs = ec.get_chunk_size(1 << 20)
+    assert (cs * 8) % ec.get_alignment() == 0
+    assert cs * 8 >= 1 << 20
+    # 1 MiB divides evenly: chunk = 128 KiB exactly
+    assert cs == (1 << 20) // 8
+    # isa: per-chunk 32B alignment
+    ec2 = registry().factory("isa", {"k": "7", "m": "3"})
+    assert ec2.get_chunk_size(1000) % 32 == 0
+
+
+def test_padding_roundtrip():
+    # non-aligned object sizes are zero-padded and still round-trip
+    ec = registry().factory("jerasure",
+                            {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    for size in (1, 100, 4095, 4097):
+        data = bytes((i * 7) % 256 for i in range(size))
+        roundtrip(ec, data, (0, 5))
+
+
+def test_profile_errors():
+    reg = registry()
+    with pytest.raises(ValueError, match="not a valid coding technique"):
+        reg.factory("jerasure", {"technique": "nope"})
+    with pytest.raises(ValueError, match="must be one of 8, 16, 32"):
+        reg.factory("jerasure", {"technique": "reed_sol_van", "w": "9"})
+    with pytest.raises(ValueError, match="k=1 must be >= 2"):
+        reg.factory("jerasure", {"technique": "reed_sol_van", "k": "1"})
+    with pytest.raises(ValueError, match="odd prime"):
+        reg.factory("jerasure", {"technique": "liberation", "k": "4", "w": "8"})
+    with pytest.raises(ValueError, match="not a valid technique"):
+        reg.factory("isa", {"technique": "liberation"})
+    with pytest.raises(ValueError, match="could not convert"):
+        reg.factory("jerasure", {"technique": "reed_sol_van", "k": "zork"})
+
+
+def test_registry_load_errors():
+    reg = registry()
+    with pytest.raises(IOError, match="dlopen"):
+        reg.load("no_such_plugin")
+
+
+def test_registry_caches_plugin_instances():
+    reg = registry()
+    p1 = reg.load("jerasure")
+    p2 = reg.load("jerasure")
+    assert p1 is p2
+
+
+def test_minimum_to_decode():
+    ec = registry().factory("jerasure",
+                            {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    # all wanted available -> exactly the wanted set
+    mini = ec.minimum_to_decode({0, 1}, {0, 1, 2, 3, 4, 5})
+    assert set(mini) == {0, 1}
+    # chunk 0 missing -> first k available
+    mini = ec.minimum_to_decode({0, 1, 2, 3}, {1, 2, 3, 4, 5})
+    assert set(mini) == {1, 2, 3, 4}
+    assert all(v == [(0, 1)] for v in mini.values())
+    with pytest.raises(IOError):
+        ec.minimum_to_decode({0}, {1, 2, 3})
+
+
+def test_decode_concat():
+    ec = registry().factory("jerasure",
+                            {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    data = bytes(range(200))
+    encoded = ec.encode(set(range(6)), data)
+    del encoded[1], encoded[2]
+    out = ec.decode_concat(encoded)
+    assert out[:200] == data
