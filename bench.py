@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""North-star benchmark: jerasure-equivalent encode, k=8 m=3, 1 MiB stripes.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- value: batched encode GB/s on the default JAX backend (TPU when
+  present), HBM-resident (kernel + HBM traffic; host<->device staging is
+  excluded because this machine reaches the chip over a network tunnel
+  whose ~30 MB/s up / ~5 MB/s down is not representative of real PCIe).
+- vs_baseline: ratio against the CPU baseline measured in-process — the
+  numpy GF(2^8) region ops (ceph_tpu.ops.regionops), this framework's
+  stand-in for the reference's jerasure/gf-complete CPU path
+  (BASELINE.md: reference binary numbers unmeasured; mount empty).
+
+Config matches BASELINE.json north_star: plugin=jerasure,
+technique=reed_sol_van, k=8, m=3, 1 MiB stripes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+
+NORTH_STAR = ["--plugin", "jerasure",
+              "--parameter", "technique=reed_sol_van",
+              "--parameter", "k=8", "--parameter", "m=3",
+              "--size", str(1 << 20), "--workload", "encode"]
+
+
+def _run(extra: list[str]) -> dict:
+    bench = ErasureCodeBench()
+    bench.setup(NORTH_STAR + extra)
+    return bench.run()
+
+
+def main() -> int:
+    # CPU baseline: numpy reference region ops, small batch.
+    host = _run(["--device", "host", "--batch", "4", "--iterations", "3"])
+    # TPU (or default backend) batched path, HBM-resident (see module
+    # docstring; completion barriers are handled by the harness).
+    jaxr = _run(["--device", "jax", "--batch", "64", "--iterations", "100",
+                 "--resident"])
+    out = {
+        "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
+        "value": round(jaxr["gbps"], 3),
+        "unit": "GB/s",
+        "vs_baseline": round(jaxr["gbps"] / host["gbps"], 3)
+        if host["gbps"] > 0 else None,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

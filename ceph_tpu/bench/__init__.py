@@ -1,0 +1,3 @@
+"""Benchmark harness (mirrors src/test/erasure-code/ceph_erasure_code_benchmark.{h,cc})."""
+
+from .erasure_code_benchmark import ErasureCodeBench, main  # noqa: F401

@@ -1,0 +1,255 @@
+"""ErasureCodeBench — the metric source, CLI-compatible with the reference.
+
+Mirrors src/test/erasure-code/ceph_erasure_code_benchmark.{h,cc} ->
+class ErasureCodeBench:
+- setup(): boost::program_options flags --plugin/-p, --workload/-w
+  encode|decode, --iterations/-i, --size/-s, --parameter/-P (repeated
+  k=v into the ErasureCodeProfile), --erasures/-e, --erasures-generation
+  random|exhaustive, --erased (repeated chunk ids), --verbose/-v.
+- run() -> encode() | decode(); the reference prints
+  "<elapsed seconds>\t<total KiB processed>" — same here (plus --json).
+
+TPU-native extensions (no reference analogue — the reference processes one
+stripe per call on the CPU; batching stripes into HBM is this framework's
+core performance primitive, SURVEY.md §2.3):
+- --batch B        process B stripes of --size bytes per encode call
+                   (total bytes per iteration = B * size).
+- --device host|jax
+                   host = numpy reference region ops (the CPU baseline);
+                   jax = batched XLA/Pallas path on the default backend
+                   (TPU when present). Default: jax.
+- --resident       keep data resident in HBM across iterations (kernel-only
+                   timing; default includes host->HBM staging + parity
+                   fetch-back each iteration, the honest PCIe-inclusive
+                   number).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from ..codes.registry import ErasureCodePluginRegistry
+
+
+def _parse_parameters(params: List[str]) -> Dict[str, str]:
+    profile: Dict[str, str] = {}
+    for p in params:
+        if "=" not in p:
+            raise ValueError(f"--parameter {p!r} must be name=value")
+        name, value = p.split("=", 1)
+        profile[name] = value
+    return profile
+
+
+class ErasureCodeBench:
+    """Benchmark driver (ceph_erasure_code_benchmark.cc -> ErasureCodeBench)."""
+
+    def __init__(self) -> None:
+        self.args = None
+        self.profile: Dict[str, str] = {}
+
+    # -- setup (ceph_erasure_code_benchmark.cc -> ErasureCodeBench::setup) --
+
+    def setup(self, argv: List[str]) -> None:
+        ap = argparse.ArgumentParser(
+            prog="ceph_erasure_code_benchmark",
+            description="erasure code benchmark (reference-CLI-compatible)")
+        ap.add_argument("-p", "--plugin", default="jerasure",
+                        help="erasure code plugin name")
+        ap.add_argument("-w", "--workload", default="encode",
+                        choices=["encode", "decode"])
+        ap.add_argument("-i", "--iterations", type=int, default=1)
+        ap.add_argument("-s", "--size", type=int, default=1 << 20,
+                        help="object size (bytes) per stripe")
+        ap.add_argument("-P", "--parameter", action="append", default=[],
+                        help="profile parameter name=value (repeatable)")
+        ap.add_argument("-e", "--erasures", type=int, default=1,
+                        help="number of chunks to erase (decode workload)")
+        ap.add_argument("-E", "--erasures-generation", default="random",
+                        choices=["random", "exhaustive"], dest="erasures_generation")
+        ap.add_argument("--erased", action="append", type=int, default=None,
+                        help="explicit chunk id to erase (repeatable)")
+        ap.add_argument("-v", "--verbose", action="store_true")
+        # TPU-native extensions
+        ap.add_argument("--batch", type=int, default=1,
+                        help="stripes per call (TPU batching extension)")
+        ap.add_argument("--device", default="jax", choices=["host", "jax"])
+        ap.add_argument("--resident", action="store_true",
+                        help="keep data in HBM across iterations")
+        ap.add_argument("--json", action="store_true", dest="json_out")
+        ap.add_argument("--seed", type=int, default=42)
+        self.args = ap.parse_args(argv)
+        self.profile = _parse_parameters(self.args.parameter)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _instance(self):
+        registry = ErasureCodePluginRegistry.instance()
+        ec = registry.factory(self.args.plugin, dict(self.profile))
+        if self.args.device == "host":
+            # pin the numpy reference path: without this, batches over
+            # min_xla_bytes would dispatch to XLA on the default backend
+            # and the "CPU baseline" would not be a CPU baseline
+            ec.min_xla_bytes = float("inf")
+        return ec
+
+    def _make_batch(self, ec) -> np.ndarray:
+        """(batch, k, chunk_size) uint8 of random stripes."""
+        a = self.args
+        k = ec.get_data_chunk_count()
+        chunk_size = ec.get_chunk_size(a.size)
+        rng = np.random.default_rng(a.seed)
+        data = rng.integers(0, 256, size=(a.batch, k, chunk_size),
+                            dtype=np.uint8)
+        return data
+
+    # -- encode (ceph_erasure_code_benchmark.cc -> encode()) ---------------
+
+    def encode(self) -> dict:
+        a = self.args
+        ec = self._instance()
+        data = self._make_batch(ec)
+        in_bytes_per_iter = data.nbytes  # batch * k * chunk_size
+
+        if a.device == "host":
+            ec.encode_chunks_batch(data)  # warm caches
+            begin = time.perf_counter()
+            for _ in range(a.iterations):
+                ec.encode_chunks_batch(data)
+            elapsed = time.perf_counter() - begin
+        else:
+            # NB: on tunneled devices block_until_ready can return before
+            # execution finishes; a tiny fetch from the last output is the
+            # reliable completion barrier (queue ordering guarantees all
+            # prior dispatches are done). Its ~fixed latency is amortized
+            # over the iteration count.
+            import jax
+            if a.resident:
+                dev_data = jax.device_put(data)
+                out = ec.encode_chunks_jax(dev_data)  # compile/warmup
+                np.asarray(out[0, 0, :4])
+                begin = time.perf_counter()
+                for _ in range(a.iterations):
+                    out = ec.encode_chunks_jax(dev_data)
+                np.asarray(out[0, 0, :4])  # completion barrier
+                elapsed = time.perf_counter() - begin
+            else:
+                def run():
+                    d = jax.device_put(data)
+                    return np.asarray(ec.encode_chunks_jax(d))
+                run()  # compile/warmup outside the timed loop
+                begin = time.perf_counter()
+                for _ in range(a.iterations):
+                    run()
+                elapsed = time.perf_counter() - begin
+        total_bytes = in_bytes_per_iter * a.iterations
+        return self._result("encode", elapsed, total_bytes)
+
+    # -- decode (ceph_erasure_code_benchmark.cc -> decode()) ---------------
+
+    def _erasure_patterns(self, n: int) -> List[tuple]:
+        """Sequence of erased-chunk tuples, one per iteration.
+
+        Mirrors the reference: --erased pins an explicit set; exhaustive
+        cycles all C(n, erasures) combinations; random draws per iteration.
+        """
+        a = self.args
+        if a.erasures > n:
+            raise ValueError(
+                f"--erasures {a.erasures} exceeds chunk count {n}")
+        if a.erased:
+            return [tuple(sorted(a.erased))] * a.iterations
+        if a.erasures_generation == "exhaustive":
+            combos = list(itertools.combinations(range(n), a.erasures))
+            reps = (a.iterations + len(combos) - 1) // len(combos)
+            return (combos * reps)[:a.iterations]
+        rng = np.random.default_rng(a.seed + 1)
+        return [tuple(sorted(rng.choice(n, size=a.erasures, replace=False)))
+                for _ in range(a.iterations)]
+
+    def decode(self) -> dict:
+        a = self.args
+        ec = self._instance()
+        n = ec.get_chunk_count()
+        data = self._make_batch(ec)
+        parity = np.asarray(ec.encode_chunks_batch(data))
+        allchunks = np.concatenate([data, parity], axis=1)  # (B, n, C)
+        patterns = self._erasure_patterns(n)
+
+        if a.device == "jax":
+            import jax
+            dev = jax.device_put(allchunks)
+            # warmup every distinct pattern (compile outside the timed loop)
+            for pat in sorted(set(patterns)):
+                available = tuple(i for i in range(n) if i not in pat)
+                out = ec.decode_chunks_jax(dev[:, np.array(available), :],
+                                           available, pat)
+            np.asarray(out[0, 0, :4])
+            begin = time.perf_counter()
+            for pat in patterns:
+                available = tuple(i for i in range(n) if i not in pat)
+                out = ec.decode_chunks_jax(dev[:, np.array(available), :],
+                                           available, pat)
+            np.asarray(out[0, 0, :4])  # completion barrier
+            elapsed = time.perf_counter() - begin
+        else:
+            for pat in sorted(set(patterns)):  # warm decode-matrix caches
+                available = tuple(i for i in range(n) if i not in pat)
+                ec.decode_chunks_batch(
+                    np.ascontiguousarray(allchunks[:, available, :]),
+                    available, pat)
+            begin = time.perf_counter()
+            for pat in patterns:
+                available = tuple(i for i in range(n) if i not in pat)
+                survivors = np.ascontiguousarray(allchunks[:, available, :])
+                ec.decode_chunks_batch(survivors, available, pat)
+            elapsed = time.perf_counter() - begin
+        total_bytes = data.nbytes * a.iterations
+        return self._result("decode", elapsed, total_bytes)
+
+    # -- output -------------------------------------------------------------
+
+    def _result(self, workload: str, elapsed: float, total_bytes: int) -> dict:
+        gbps = total_bytes / elapsed / 1e9 if elapsed > 0 else float("inf")
+        return {
+            "workload": workload,
+            "plugin": self.args.plugin,
+            "profile": dict(self.profile),
+            "seconds": elapsed,
+            "total_bytes": total_bytes,
+            "batch": self.args.batch,
+            "iterations": self.args.iterations,
+            "size": self.args.size,
+            "device": self.args.device,
+            "gbps": gbps,
+        }
+
+    def run(self) -> dict:
+        if self.args.workload == "encode":
+            return self.encode()
+        return self.decode()
+
+
+def main(argv: List[str] | None = None) -> int:
+    bench = ErasureCodeBench()
+    bench.setup(argv if argv is not None else sys.argv[1:])
+    res = bench.run()
+    if bench.args.json_out:
+        print(json.dumps(res))
+    else:
+        # reference output: "<elapsed seconds>\t<total KiB>"
+        print(f"{res['seconds']:.6f}\t{res['total_bytes'] // 1024}")
+        if bench.args.verbose:
+            print(f"{res['gbps']:.3f} GB/s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -123,9 +123,10 @@ class _BitmatrixTechnique(BitmatrixCodeMixin, ErasureCodeJerasure):
         """ErasureCodeJerasureCauchy/Liberation::get_alignment."""
         if self.per_chunk_alignment:
             alignment = self.w * self.packetsize
-            modulo = alignment % LARGEST_VECTOR_WORDSIZE
-            if modulo:
-                alignment += LARGEST_VECTOR_WORDSIZE - modulo
+            if alignment % LARGEST_VECTOR_WORDSIZE:
+                # keep the result a multiple of w*packetsize (the packet
+                # layout requires it), like the non-per-chunk branch below
+                alignment *= LARGEST_VECTOR_WORDSIZE
             return alignment
         alignment = self.k * self.w * self.packetsize * SIZEOF_INT
         if (self.w * self.packetsize * SIZEOF_INT) % LARGEST_VECTOR_WORDSIZE:

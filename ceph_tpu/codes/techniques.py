@@ -27,6 +27,8 @@ from ..ops.xla_ops import (
     apply_bitmatrix_xla,
     apply_matrix_xla,
     bitmatrix_to_static,
+    jax_bytes_view,
+    jax_words_view,
     matrix_to_static,
 )
 
@@ -77,6 +79,22 @@ class MatrixCodeMixin:
         return self._apply(np.ascontiguousarray(chunks[..., :ns, :]), dm,
                            dm_static)
 
+    # -- device-resident paths (jax array in, jax array out; no host copy) --
+
+    def encode_chunks_jax(self, data):
+        """(batch, k, C) uint8 device array -> (batch, m, C) parity on device."""
+        words = jax_words_view(data, self.w)
+        return jax_bytes_view(
+            apply_matrix_xla(words, self._matrix_static, self.w))
+
+    def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
+        """(batch, len(available), C) device array -> (batch, len(erased), C)."""
+        if len(available) < self.k:
+            raise IOError(f"need {self.k} chunks, have {len(available)}")
+        _, dm_static, ns = self._decode_matrix(tuple(available), tuple(erased))
+        words = jax_words_view(chunks[..., :ns, :], self.w)
+        return jax_bytes_view(apply_matrix_xla(words, dm_static, self.w))
+
 
 class BitmatrixCodeMixin:
     """Compute paths for GF(2) bitmatrix codes in jerasure packet layout.
@@ -126,3 +144,19 @@ class BitmatrixCodeMixin:
                                                    tuple(erased))
         return self._apply(np.ascontiguousarray(chunks[..., :ns, :]), dm,
                            dm_static)
+
+    # -- device-resident paths (jax array in, jax array out; no host copy) --
+
+    def encode_chunks_jax(self, data):
+        """(batch, k, C) uint8 device array -> (batch, m, C) parity on device."""
+        return apply_bitmatrix_xla(data, self._bitmatrix_static, self.w,
+                                   self.packetsize)
+
+    def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
+        """(batch, len(available), C) device array -> (batch, len(erased), C)."""
+        if len(available) < self.k:
+            raise IOError(f"need {self.k} chunks, have {len(available)}")
+        _, dm_static, ns = self._decode_bitmatrix(tuple(available),
+                                                  tuple(erased))
+        return apply_bitmatrix_xla(chunks[..., :ns, :], dm_static, self.w,
+                                   self.packetsize)

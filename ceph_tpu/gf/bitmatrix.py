@@ -73,7 +73,6 @@ def cauchy_n_ones_all(w: int) -> np.ndarray:
     out[v] = bitmatrix ones of v, for v in [0, 2^w). Used to rank RAID-6
     row candidates (the cbest enumeration) without 2^w scalar GF calls.
     """
-    dtype = {4: np.uint8, 8: np.uint8, 16: np.uint16}.get(w, np.uint32)
     mask = (1 << w) - 1
     fb = DEFAULT_POLY[w] & mask
     v = np.arange(1 << w, dtype=np.uint64)

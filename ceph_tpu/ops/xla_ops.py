@@ -45,16 +45,43 @@ def _xtime(v: jax.Array, w: int = 8) -> jax.Array:
     return ((v << dt(1)) ^ (hi * fb)).astype(dt)
 
 
+def _xtime_swar8(v: jax.Array) -> jax.Array:
+    """xtime on uint32 lanes each packing 4 independent GF(2^8) bytes.
+
+    TPU VPU lanes are 32-bit; uint8 elementwise ops occupy a full lane per
+    byte. Packing 4 field bytes per lane quadruples throughput. Per-byte
+    independence: MSBs are cleared before the shift (no cross-byte carry)
+    and the feedback multiply (hi>>7)*0x1d stays within each byte.
+    """
+    hi = v & jnp.uint32(0x80808080)
+    return ((v ^ hi) << jnp.uint32(1)) ^ ((hi >> jnp.uint32(7))
+                                          * jnp.uint32(GF8_FEEDBACK))
+
+
+from ..gf.gf8 import GF8_POLY
+
+GF8_FEEDBACK = GF8_POLY & 0xFF  # 0x1d
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def apply_matrix_xla(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
     """Apply static (r, s) GF(2^w) matrix to (..., s, C) words -> (..., r, C).
 
     Equivalent of jerasure_matrix_encode / ISA-L ec_encode_data on a batch;
     ``chunks`` dtype must be the w-bit word dtype (uint8/uint16/uint32).
+    w=8 runs SWAR-packed on uint32 lanes (4 field bytes per lane).
     """
     r = len(matrix_t)
     s = len(matrix_t[0])
     assert chunks.shape[-2] == s
+    swar = w == 8 and chunks.dtype == jnp.uint8 and chunks.shape[-1] % 4 == 0
+    if swar:
+        c4 = chunks.shape[-1] // 4
+        chunks = jax.lax.bitcast_convert_type(
+            chunks.reshape(chunks.shape[:-1] + (c4, 4)), jnp.uint32)
+        xt = _xtime_swar8
+    else:
+        xt = lambda v: _xtime(v, w)  # noqa: E731
     # shared doubling planes per input chunk; XLA dead-code-eliminates
     # planes no matrix entry uses.
     planes = []
@@ -62,7 +89,7 @@ def apply_matrix_xla(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
         v = chunks[..., j, :]
         pj = [v]
         for _ in range(w - 1):
-            v = _xtime(v, w)
+            v = xt(v)
             pj.append(v)
         planes.append(pj)
     outs = []
@@ -80,12 +107,35 @@ def apply_matrix_xla(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
         if acc is None:
             acc = jnp.zeros_like(chunks[..., 0, :])
         outs.append(acc)
-    return jnp.stack(outs, axis=-2)
+    out = jnp.stack(outs, axis=-2)
+    if swar:
+        out = jax.lax.bitcast_convert_type(out, jnp.uint8)
+        out = out.reshape(out.shape[:-2] + (out.shape[-2] * 4,))
+    return out
 
 
 def encode_matrix_xla(data: jax.Array, matrix, w: int = 8) -> jax.Array:
     """Convenience: numpy matrix in, parity (..., m, C) out."""
     return apply_matrix_xla(data, matrix_to_static(matrix), w)
+
+
+def jax_words_view(data: jax.Array, w: int) -> jax.Array:
+    """(..., C) uint8 device array -> (..., C/(w/8)) w-bit word view (bitcast)."""
+    if w == 8:
+        return data
+    ratio = w // 8
+    assert data.shape[-1] % ratio == 0
+    return jax.lax.bitcast_convert_type(
+        data.reshape(data.shape[:-1] + (data.shape[-1] // ratio, ratio)),
+        _JNP_DTYPE[w])
+
+
+def jax_bytes_view(words: jax.Array) -> jax.Array:
+    """w-bit word device array -> uint8 bytes (bitcast, inverse of above)."""
+    if words.dtype == jnp.uint8:
+        return words
+    out = jax.lax.bitcast_convert_type(words, jnp.uint8)
+    return out.reshape(out.shape[:-2] + (out.shape[-2] * out.shape[-1],))
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))

@@ -1,0 +1,17 @@
+"""Multi-chip parallelism: device meshes, sharded EC compute, collectives.
+
+The reference scales by sending shard sub-ops over its AsyncMessenger
+(src/msg/async/, SURVEY.md §2.1 "Messenger") between OSD processes. The
+TPU-native equivalent keeps the whole stripe batch on a jax.sharding.Mesh
+and lets XLA insert ICI/DCN collectives (SURVEY.md §2.3 parallelism map):
+
+- stripe axis ("dp"): stripes are independent -> pure data parallelism,
+  zero cross-chip traffic (the reference's "many objects in flight").
+- chunk axis ("tp"): the k data chunks of a stripe spread across chips
+  (the reference's "shards across OSDs"); parity needs an XOR-reduction
+  across chips -> all_gather/psum-style collective over ICI, replacing
+  the messenger's MOSDECSubOpWrite fan-out.
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from .sharded_codes import sharded_encode, sharded_roundtrip_step  # noqa: F401

@@ -1,0 +1,33 @@
+"""Device-mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, tp: int | None = None,
+              axis_names: tuple[str, str] = ("stripe", "chunk")) -> Mesh:
+    """Build a 2D (stripe=dp, chunk=tp) mesh over the first n devices.
+
+    tp defaults to the largest power of two <= 4 dividing both n_devices
+    and 8 (the chunk axis shards k data chunks; k is 8 in the flagship
+    config). tp=1 degrades to pure data parallelism.
+    """
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(f"asked for {n_devices} devices, "
+                         f"have {len(devices)}")
+    if tp is None:
+        tp = 1
+        for cand in (2, 4):
+            if n_devices % cand == 0:
+                tp = cand
+    if n_devices % tp:
+        raise ValueError(f"tp={tp} does not divide n_devices={n_devices}")
+    dp = n_devices // tp
+    grid = np.array(devices[:n_devices]).reshape(dp, tp)
+    return Mesh(grid, axis_names)

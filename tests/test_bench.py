@@ -1,0 +1,80 @@
+"""Benchmark harness tests (CLI parity + numbers sane)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench, main
+
+
+def run_bench(argv):
+    b = ErasureCodeBench()
+    b.setup(argv)
+    return b.run()
+
+
+def test_encode_host_smoke():
+    res = run_bench(["--plugin", "jerasure",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--iterations", "2",
+                     "--device", "host"])
+    assert res["workload"] == "encode"
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"k": "4", "m": "2"})
+    assert res["total_bytes"] == 2 * 4 * ec.get_chunk_size(4096)
+    assert res["gbps"] > 0
+
+
+def test_encode_jax_matches_reference_cli_output(capsys):
+    rc = main(["--plugin", "jerasure",
+               "--parameter", "k=2", "--parameter", "m=1",
+               "--size", "4096", "--iterations", "1",
+               "--device", "host"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip()
+    # reference format: "<seconds>\t<KiB>"
+    secs, kib = out.split("\t")
+    float(secs)
+    assert int(kib) >= 4
+
+def test_encode_json_output(capsys):
+    rc = main(["--plugin", "isa",
+               "--parameter", "k=4", "--parameter", "m=2",
+               "--size", "8192", "--iterations", "1", "--json",
+               "--device", "jax"])
+    assert rc == 0
+    res = json.loads(capsys.readouterr().out)
+    assert res["plugin"] == "isa"
+    assert res["gbps"] > 0
+
+
+@pytest.mark.parametrize("gen", ["random", "exhaustive"])
+def test_decode_workloads(gen):
+    res = run_bench(["--plugin", "jerasure",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--iterations", "3",
+                     "--workload", "decode", "--erasures", "2",
+                     "--erasures-generation", gen, "--device", "host"])
+    assert res["workload"] == "decode"
+    assert res["total_bytes"] > 0
+
+
+def test_decode_erased_explicit():
+    res = run_bench(["--plugin", "jerasure",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--iterations", "2",
+                     "--workload", "decode", "--erased", "0", "--erased", "5",
+                     "--device", "host"])
+    assert res["total_bytes"] > 0
+
+
+def test_batch_extension_scales_bytes():
+    r1 = run_bench(["--parameter", "k=4", "--parameter", "m=2",
+                    "--size", "4096", "--iterations", "1",
+                    "--batch", "1", "--device", "host"])
+    r8 = run_bench(["--parameter", "k=4", "--parameter", "m=2",
+                    "--size", "4096", "--iterations", "1",
+                    "--batch", "8", "--device", "host"])
+    assert r8["total_bytes"] == 8 * r1["total_bytes"]
