@@ -86,6 +86,10 @@ class ErasureCodeBench:
         ap.add_argument("--json", action="store_true", dest="json_out")
         ap.add_argument("--seed", type=int, default=42)
         self.args = ap.parse_args(argv)
+        if self.args.iterations < 1:
+            ap.error(f"--iterations {self.args.iterations} must be >= 1")
+        if self.args.batch < 1:
+            ap.error(f"--batch {self.args.batch} must be >= 1")
         self.profile = _parse_parameters(self.args.parameter)
 
     # -- helpers ------------------------------------------------------------

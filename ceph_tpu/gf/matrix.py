@@ -85,3 +85,34 @@ def gf_invert_matrix(mat, w: int = 8, poly: int | None = None) -> np.ndarray:
 
 def is_invertible(mat, w: int = 8, poly: int | None = None) -> bool:
     return gf_gaussian_inverse(mat, w, poly) is not None
+
+
+def gf_rank(mat, w: int = 8, poly: int | None = None) -> int:
+    """Rank of a matrix over GF(2^w) by Gaussian elimination."""
+    a = np.array(mat, dtype=np.int64, copy=True)
+    if a.size == 0:
+        return 0
+    rows, cols = a.shape
+    rank = 0
+    for col in range(cols):
+        pivot = -1
+        for row in range(rank, rows):
+            if a[row, col] != 0:
+                pivot = row
+                break
+        if pivot < 0:
+            continue
+        if pivot != rank:
+            a[[rank, pivot]] = a[[pivot, rank]]
+        pinv = gf_inv(int(a[rank, col]), w, poly)
+        for j in range(cols):
+            a[rank, j] = gf_mul(int(a[rank, j]), pinv, w, poly)
+        for row in range(rows):
+            if row != rank and a[row, col] != 0:
+                f = int(a[row, col])
+                for j in range(cols):
+                    a[row, j] ^= gf_mul(f, int(a[rank, j]), w, poly)
+        rank += 1
+        if rank == rows:
+            break
+    return rank

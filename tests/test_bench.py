@@ -27,7 +27,7 @@ def test_encode_host_smoke():
     assert res["gbps"] > 0
 
 
-def test_encode_jax_matches_reference_cli_output(capsys):
+def test_encode_host_matches_reference_cli_output(capsys):
     rc = main(["--plugin", "jerasure",
                "--parameter", "k=2", "--parameter", "m=1",
                "--size", "4096", "--iterations", "1",

@@ -1,0 +1,143 @@
+"""shec plugin tests — round-trip, coverage, locality, recovery search.
+
+Models the reference's TestErasureCodeShec.cc (+ _all / _arguments
+variants): exhaustive erasure round-trips over k/m/c sweeps, invalid
+profile rejection, and the locality property that motivates shec (single
+failure repairs read fewer than k chunks).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codes.plugins.shec import _shec_coding_matrix
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+from ceph_tpu.gf.matrix import gf_rank
+
+
+def make(profile):
+    return ErasureCodePluginRegistry.instance().factory("shec", profile)
+
+
+def roundtrip(ec, erased, nbytes=997, seed=7):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, size=nbytes, dtype=np.uint8).tobytes()
+    n = ec.get_chunk_count()
+    encoded = ec.encode(set(range(n)), data)
+    available = {i: encoded[i] for i in range(n) if i not in erased}
+    chunk_size = len(encoded[0])
+    decoded = ec.decode(set(erased), available, chunk_size)
+    for c in erased:
+        assert decoded[c] == encoded[c], f"chunk {c} mismatch"
+
+
+def is_recoverable(matrix, k, w, erased):
+    """Ground truth: erased data chunks recoverable iff the generator rows
+    of the surviving chunks span the erased data coordinates."""
+    m = matrix.shape[0]
+    full = np.vstack([np.eye(k, dtype=np.int64), matrix])
+    survivors = [i for i in range(k + m) if i not in erased]
+    sub = full[survivors]
+    erased_data = [c for c in erased if c < k]
+    if not erased_data:
+        return True
+    return gf_rank(sub, w) == k
+
+
+class TestShecMatrix:
+    def test_coverage_at_least_c(self):
+        for k, m, c in [(4, 3, 2), (6, 3, 2), (8, 4, 3), (10, 6, 3),
+                        (5, 2, 1), (6, 4, 2)]:
+            mat = _shec_coding_matrix(k, m, c, 8)
+            cover = (mat != 0).sum(axis=0)
+            assert (cover >= c).all(), (k, m, c, cover)
+
+    def test_window_width(self):
+        for k, m, c in [(6, 3, 2), (8, 4, 3), (10, 5, 2)]:
+            mat = _shec_coding_matrix(k, m, c, 8)
+            width = -(-k * c // m)
+            assert ((mat != 0).sum(axis=1) == width).all()
+
+    def test_dense_when_c_equals_m(self):
+        mat = _shec_coding_matrix(6, 3, 3, 8)
+        assert (mat != 0).all()
+
+
+class TestShecRoundTrip:
+    @pytest.mark.parametrize("k,m,c", [(4, 3, 2), (6, 3, 2), (8, 4, 3)])
+    def test_all_single_and_double_erasures(self, k, m, c):
+        ec = make({"k": str(k), "m": str(m), "c": str(c)})
+        n = k + m
+        for r in (1, 2):
+            for erased in itertools.combinations(range(n), r):
+                if is_recoverable(ec.matrix, k, 8, set(erased)):
+                    roundtrip(ec, set(erased))
+
+    def test_up_to_c_erasures_always_recoverable(self):
+        """Durability-c claim: any <= c erasures decode."""
+        for k, m, c in [(4, 3, 2), (6, 3, 2), (8, 4, 3)]:
+            ec = make({"k": str(k), "m": str(m), "c": str(c)})
+            n = k + m
+            for erased in itertools.combinations(range(n), c):
+                assert is_recoverable(ec.matrix, k, 8, set(erased)), \
+                    (k, m, c, erased)
+                roundtrip(ec, set(erased))
+
+    def test_unrecoverable_raises(self):
+        ec = make({"k": "6", "m": "3", "c": "2"})
+        # erase 4 > m chunks: must be unrecoverable
+        with pytest.raises(IOError):
+            ec.minimum_to_decode({0, 1, 2, 3}, set(range(4, 9)))
+
+    def test_w16_roundtrip(self):
+        ec = make({"k": "4", "m": "3", "c": "2", "w": "16"})
+        roundtrip(ec, {1, 5})
+
+    def test_batch_matches_single(self):
+        ec = make({"k": "6", "m": "3", "c": "2"})
+        rng = np.random.default_rng(3)
+        chunk = ec.get_chunk_size(6 * 64)
+        data = rng.integers(0, 256, size=(4, 6, chunk), dtype=np.uint8)
+        parity = ec.encode_chunks_batch(data)
+        allc = np.concatenate([data, parity], axis=1)
+        erased = (2, 7)
+        available = tuple(i for i in range(9) if i not in erased)
+        rec = ec.decode_chunks_batch(
+            np.ascontiguousarray(allc[:, available, :]), available, erased)
+        for b in range(4):
+            assert np.array_equal(rec[b, 0], allc[b, 2])
+            assert np.array_equal(rec[b, 1], allc[b, 7])
+
+
+class TestShecLocality:
+    def test_single_failure_reads_fewer_than_k(self):
+        """The point of shec: one lost chunk repairs from a local window."""
+        ec = make({"k": "8", "m": "4", "c": "3"})
+        width = -(-8 * 3 // 4)  # shingle width l = 6
+        minimum = ec.minimum_to_decode({0}, set(range(1, 12)))
+        assert len(minimum) <= width  # l-1 data + 1 parity at most
+        assert len(minimum) < 8
+
+    def test_minimum_includes_available_wanted(self):
+        ec = make({"k": "4", "m": "3", "c": "2"})
+        minimum = ec.minimum_to_decode({0, 1}, set(range(7)))
+        assert set(minimum) == {0, 1}
+
+
+class TestShecArguments:
+    @pytest.mark.parametrize("profile", [
+        {"k": "4", "m": "3", "c": "4"},      # c > m
+        {"k": "4", "m": "5", "c": "2"},      # m > k
+        {"k": "4", "m": "3", "c": "0"},      # c < 1
+        {"k": "1", "m": "1", "c": "1"},      # k < 2
+        {"k": "4", "m": "3", "c": "2", "w": "9"},  # bad w
+        {"k": "4", "m": "3", "c": "2", "technique": "bogus"},
+    ])
+    def test_invalid_profiles(self, profile):
+        with pytest.raises(ValueError):
+            make(profile)
+
+    def test_defaults(self):
+        ec = make({})
+        assert (ec.k, ec.m, ec.c, ec.w) == (4, 3, 2, 8)
