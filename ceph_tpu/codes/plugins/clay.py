@@ -1,0 +1,664 @@
+"""Clay (coupled-layer) MSR regenerating-code plugin.
+
+Mirrors src/erasure-code/clay/ErasureCodeClay.{h,cc} +
+ErasureCodePluginClay.cc:
+
+- profile k, m, d (k <= d <= k+m-1, default k+m-1), scalar_mds
+  (jerasure|isa), technique (reed_sol_van; cauchy for isa).
+- geometry: q = d-k+1 helpers-bandwidth parameter; nu virtual (zero) data
+  chunks pad k+m to a multiple of q; t = (k+m+nu)/q columns;
+  sub_chunk_count = q^t (ErasureCodeClay.cc -> parse/prepare).
+- node grid: chunk i -> node i (i < k) or i + nu (coding), node n ->
+  (x, y) = (n % q, n / q); vertex (x, y, z) for plane z in [0, q^t).
+- pairwise coupling transform: a vertex with z_y == x is *unpaired*
+  ("hole-dot": C == U); otherwise (x,y,z) pairs with (z_y, y, z') where
+  z' = z with digit y replaced by x, and the stored (coupled) values are
+  [C_a; C_b] = PFT @ [U_a; U_b] with PFT an invertible 2x2 GF(2^8) matrix
+  (the reference builds it from a k=2,m=2 reed_sol_van jerasure code —
+  ErasureCodeClay.cc -> get_coupled_from_uncoupled / pft; here the same
+  RS(2,2) coding matrix is used directly, slot order = ascending x).
+- decode_layered: planes processed in increasing erased-dot intersection
+  score; per plane, uncouple good vertices (pair available -> 2x2 inverse;
+  pair erased -> type-1 recovery from the earlier plane's U), then one
+  scalar-MDS decode in the U domain (ErasureCodeClay.cc ->
+  decode_layered / decode_erasures / recover_type1_erasure).
+- encode == decode_layered with all m coding nodes erased
+  (ErasureCodeClay.cc -> encode_chunks).
+- single-chunk repair reads only the q^(t-1) planes with z_y == x (the
+  "repair planes"), i.e. sub_chunk_count/q sub-chunks from each of d
+  helpers (ErasureCodeClay.cc -> is_repair / repair /
+  repair_one_lost_chunk / minimum_to_decode with sub-chunk ranges).
+
+TPU-first addition (no reference analogue): every fixed
+(erasure-pattern, geometry) clay transform is GF(2^8)-linear and
+byte-position-independent, so the whole layered pipeline is *probed once*
+with impulse inputs into a composite (out_subchunks x in_subchunks)
+GF(2^8) matrix; the batched paths then run ONE matrix application over
+(batch, chunks, chunk_size) arrays — the same single-kernel hot loop as
+every other plugin here, MXU/Pallas-ready.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ...gf.gf8 import gf_inv
+from ...gf.matrix import gf_invert_matrix
+from ...matrices.isal import gf_gen_cauchy1_matrix, gf_gen_rs_matrix, isa_coding_rows
+from ...matrices.jerasure import reed_sol_vandermonde_coding_matrix
+from ...ops import regionops
+from ..base import ErasureCode
+from ..interface import ErasureCodeProfile
+from ..registry import ERASURE_CODE_VERSION, ErasureCodePlugin
+
+__erasure_code_version__ = ERASURE_CODE_VERSION
+
+W = 8  # clay is GF(2^8)-only in the reference (ErasureCodeClay.cc -> w=8)
+
+
+def _mul(c: int, region: np.ndarray) -> np.ndarray:
+    return regionops.mul_const_region(int(c), region, W)
+
+
+class ErasureCodeClay(ErasureCode):
+    """ErasureCodeClay.{h,cc} — coupled-layer MSR code."""
+
+    DEFAULT_K = "4"
+    DEFAULT_M = "2"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.d = 0
+        self.q = 0
+        self.t = 0
+        self.nu = 0
+        self.sub_chunk_no = 1
+        self.scalar_mds = "jerasure"
+        self.technique = "reed_sol_van"
+
+    # -- profile (ErasureCodeClay.cc -> parse) ------------------------------
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        self.k = self.to_int("k", profile, self.DEFAULT_K)
+        self.m = self.to_int("m", profile, self.DEFAULT_M)
+        self.w = W
+        self.sanity_check_k_m(self.k, self.m)
+        self.d = self.to_int("d", profile, str(self.k + self.m - 1))
+        if not (self.k <= self.d <= self.k + self.m - 1):
+            raise ValueError(
+                f"d={self.d} must be within [k={self.k}, k+m-1="
+                f"{self.k + self.m - 1}]")
+        self.scalar_mds = self.to_string("scalar_mds", profile, "jerasure")
+        self.technique = self.to_string("technique", profile, "reed_sol_van")
+        if self.scalar_mds == "isa":
+            allowed = ("reed_sol_van", "cauchy")
+        elif self.scalar_mds in ("jerasure", "shec"):
+            # bitmatrix techniques use the packet layout, which is
+            # incompatible with clay's byte-granular sub-chunk coupling;
+            # the reference gates clay to matrix techniques the same way
+            # (ErasureCodePluginClay.cc -> parse technique check).
+            allowed = ("reed_sol_van",)
+        else:
+            raise ValueError(
+                f"scalar_mds={self.scalar_mds!r} must be jerasure, isa "
+                f"or shec")
+        if self.technique not in allowed:
+            raise ValueError(
+                f"technique={self.technique!r} not supported with "
+                f"scalar_mds={self.scalar_mds} (allowed: {allowed})")
+        if self.k + self.m > 254:
+            raise ValueError(f"k+m={self.k + self.m} must be <= 254")
+
+    # -- geometry (ErasureCodeClay.cc -> prepare) ---------------------------
+
+    def prepare(self) -> None:
+        k, m = self.k, self.m
+        self.q = self.d - k + 1
+        rem = (k + m) % self.q
+        self.nu = (self.q - rem) % self.q
+        self.t = (k + m + self.nu) // self.q
+        self.sub_chunk_no = self.q ** self.t
+        self.n_nodes = self.q * self.t  # == k + nu + m
+        # scalar MDS code over nodes: k+nu data, m coding.  The reference
+        # instantiates the sub-plugin through the registry; the per-plane
+        # math only needs its (m, k+nu) coding matrix, built here with the
+        # same generators (jerasure reed_sol.c / ISA-L ec_base.c).
+        kk = k + self.nu
+        if self.scalar_mds == "isa":
+            if self.technique == "cauchy":
+                full = gf_gen_cauchy1_matrix(m + kk, kk)
+            else:
+                full = gf_gen_rs_matrix(m + kk, kk)
+            self.mds_matrix = isa_coding_rows(full, kk)
+        else:
+            self.mds_matrix = reed_sol_vandermonde_coding_matrix(kk, m, W)
+        # pairwise coupling transform: RS(2,2) coding matrix
+        # (ErasureCodeClay.cc -> pft, jerasure reed_sol_van k=2 m=2)
+        self.pft = np.asarray(reed_sol_vandermonde_coding_matrix(2, 2, W),
+                              dtype=np.int64)
+        self.pft_inv = gf_invert_matrix(self.pft, W)
+        self._plane_decode_cache: Dict[tuple, np.ndarray] = {}
+        self._linear_cache: Dict[tuple, np.ndarray] = {}
+        self._powq = [self.q ** y for y in range(self.t)]
+
+    # -- counts / sizes -----------------------------------------------------
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunk_no
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """Chunk size padded so each chunk splits into sub_chunk_no equal
+        sub-chunks (ErasureCodeClay.cc -> get_chunk_size alignment)."""
+        k = self.k
+        chunk = (stripe_width + k - 1) // k
+        align = self.sub_chunk_no
+        return (chunk + align - 1) // align * align
+
+    # -- node / vertex geometry --------------------------------------------
+
+    def _node(self, chunk_id: int) -> int:
+        """Chunk index -> node index (virtual nodes sit at k..k+nu-1)."""
+        return chunk_id if chunk_id < self.k else chunk_id + self.nu
+
+    def _chunk(self, node: int) -> int | None:
+        """Node index -> chunk index (None for virtual nodes)."""
+        if node < self.k:
+            return node
+        if node < self.k + self.nu:
+            return None
+        return node - self.nu
+
+    def _digit(self, z: int, y: int) -> int:
+        return (z // self._powq[y]) % self.q
+
+    def _pair(self, node: int, z: int) -> Tuple[int, int] | None:
+        """Paired (node, plane) of vertex (node, z); None for dots."""
+        x, y = node % self.q, node // self.q
+        xp = self._digit(z, y)
+        if xp == x:
+            return None
+        return y * self.q + xp, z + (x - xp) * self._powq[y]
+
+    # -- coupling transform -------------------------------------------------
+
+    def _slots(self, node: int, sw: int) -> Tuple[int, int]:
+        """Pair slot of ``node`` and of ``sw`` (slot 0 = smaller x)."""
+        s = 0 if (node % self.q) < (sw % self.q) else 1
+        return s, 1 - s
+
+    def _uncouple(self, c_self: np.ndarray, c_pair: np.ndarray,
+                  node: int, sw: int) -> np.ndarray:
+        """U of ``node``'s vertex from both coupled values."""
+        s, _ = self._slots(node, sw)
+        c0, c1 = (c_self, c_pair) if s == 0 else (c_pair, c_self)
+        return _mul(self.pft_inv[s, 0], c0) ^ _mul(self.pft_inv[s, 1], c1)
+
+    def _type1(self, c_self: np.ndarray, u_pair: np.ndarray,
+               node: int, sw: int) -> np.ndarray:
+        """U of ``node``'s vertex from its own C and the pair's U
+        (ErasureCodeClay.cc -> recover_type1_erasure)."""
+        s, sp = self._slots(node, sw)
+        num = c_self ^ _mul(self.pft[s, sp], u_pair)
+        return _mul(gf_inv(int(self.pft[s, s]), W), num)
+
+    def _couple(self, u_self: np.ndarray, u_pair: np.ndarray,
+                node: int, sw: int) -> np.ndarray:
+        """C of ``node``'s vertex from both uncoupled values."""
+        s, _ = self._slots(node, sw)
+        u0, u1 = (u_self, u_pair) if s == 0 else (u_pair, u_self)
+        return _mul(self.pft[s, 0], u0) ^ _mul(self.pft[s, 1], u1)
+
+    # -- layered decode core ------------------------------------------------
+
+    def _plane_decode_matrix(self, erased: Tuple[int, ...]) -> np.ndarray:
+        """(len(erased), k+nu) matrix: survivors' U -> erased nodes' U."""
+        dm = self._plane_decode_cache.get(erased)
+        if dm is None:
+            kk = self.k + self.nu
+            survivors = [n for n in range(self.n_nodes) if n not in erased]
+            dm = regionops.matrix_decode_matrix(
+                self.mds_matrix, kk, survivors, list(erased), W)
+            self._plane_decode_cache[erased] = dm
+        return dm
+
+    def _compute_u_plane(self, C: np.ndarray, U: np.ndarray,
+                         u_known: np.ndarray, c_known: np.ndarray,
+                         z: int, mds_erased: frozenset) -> None:
+        """Fill U[node, z] for every node outside ``mds_erased``."""
+        for node in range(self.n_nodes):
+            if node in mds_erased:
+                continue
+            pr = self._pair(node, z)
+            if pr is None:
+                U[node, z] = C[node, z]
+            else:
+                sw, z_sw = pr
+                if c_known[sw, z_sw]:
+                    U[node, z] = self._uncouple(C[node, z], C[sw, z_sw],
+                                                node, sw)
+                elif u_known[sw, z_sw]:
+                    U[node, z] = self._type1(C[node, z], U[sw, z_sw],
+                                             node, sw)
+                else:
+                    raise RuntimeError(
+                        f"plane ordering bug: vertex ({node},{z}) pair "
+                        f"({sw},{z_sw}) has neither C nor U known")
+            u_known[node, z] = True
+
+    def _plane_orders(self, erased: frozenset) -> List[int]:
+        """order[z] = number of erased 'dot' vertices in plane z
+        (ErasureCodeClay.cc -> set_planes_sequential_decoding_order)."""
+        orders = []
+        for z in range(self.sub_chunk_no):
+            n = 0
+            for node in erased:
+                x, y = node % self.q, node // self.q
+                if self._digit(z, y) == x:
+                    n += 1
+            orders.append(n)
+        return orders
+
+    def _decode_layered(self, C: np.ndarray, c_known: np.ndarray,
+                        erased_nodes: set) -> None:
+        """Recover C[node] for every node in ``erased_nodes`` in place.
+
+        C: (n_nodes, sub_chunk_no, sc) uint8; c_known: (n_nodes, sub) bool.
+        ErasureCodeClay.cc -> decode_layered.
+        """
+        erased = set(erased_nodes)
+        if len(erased) > self.m:
+            raise IOError(
+                f"cannot decode: {len(erased)} erasures > m={self.m}")
+        # pad pseudo-erasures up to m with coding nodes so every plane's
+        # MDS solve has a fixed pattern (ErasureCodeClay.cc ->
+        # decode_layered erasure padding)
+        for node in range(self.k + self.nu, self.n_nodes):
+            if len(erased) >= self.m:
+                break
+            if node not in erased:
+                erased.add(node)
+                c_known[node, :] = False
+        er = tuple(sorted(erased))
+        erased_f = frozenset(erased)
+        dm = self._plane_decode_matrix(er)
+        survivors = [n for n in range(self.n_nodes) if n not in erased_f]
+        orders = self._plane_orders(erased_f)
+        U = np.zeros_like(C)
+        u_known = np.zeros(C.shape[:2], dtype=bool)
+        for iscore in range(max(orders) + 1):
+            for z in range(self.sub_chunk_no):
+                if orders[z] != iscore:
+                    continue
+                self._compute_u_plane(C, U, u_known, c_known, z, erased_f)
+                solved = regionops.matrix_encode(
+                    U[survivors, z], dm, W)
+                for i, node in enumerate(er):
+                    U[node, z] = solved[i]
+                    u_known[node, z] = True
+        # recouple erased nodes (ErasureCodeClay.cc -> decode_layered tail)
+        for node in er:
+            for z in range(self.sub_chunk_no):
+                pr = self._pair(node, z)
+                if pr is None:
+                    C[node, z] = U[node, z]
+                else:
+                    sw, z_sw = pr
+                    C[node, z] = self._couple(U[node, z], U[sw, z_sw],
+                                              node, sw)
+                c_known[node, z] = True
+
+    # -- encode (ErasureCodeClay.cc -> encode_chunks via decode_layered) ----
+
+    def encode_chunks(self, want_to_encode: set,
+                      chunks: Dict[int, bytes]) -> Dict[int, bytes]:
+        k = self.k
+        chunk_size = len(chunks[0])
+        sc = chunk_size // self.sub_chunk_no
+        C = np.zeros((self.n_nodes, self.sub_chunk_no, sc), dtype=np.uint8)
+        c_known = np.zeros((self.n_nodes, self.sub_chunk_no), dtype=bool)
+        for i in range(k):
+            C[i] = np.frombuffer(chunks[i], dtype=np.uint8).reshape(
+                self.sub_chunk_no, sc)
+            c_known[i, :] = True
+        c_known[k:k + self.nu, :] = True  # virtual zero chunks
+        coding = set(range(self.k + self.nu, self.n_nodes))
+        self._decode_layered(C, c_known, coding)
+        out = dict(chunks)
+        for j in range(self.m):
+            out[k + j] = C[k + self.nu + j].tobytes()
+        return out
+
+    def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
+        """(batch, k, chunk) -> (batch, m, chunk) via the probed composite
+        encode matrix (one GF(2^8) matrix application)."""
+        M = self._probe_encode_matrix()
+        b, k, chunk = data.shape
+        sub = self.sub_chunk_no
+        sc = chunk // sub
+        x = data.reshape(b, k * sub, sc)
+        y = regionops.matrix_encode(x, M, W)
+        return y.reshape(b, self.m, chunk)
+
+    # -- minimum_to_decode (ErasureCodeClay.cc -> minimum_to_decode) --------
+
+    def is_repair(self, want_to_read: set, available: set) -> bool:
+        """Single-chunk repair eligibility (ErasureCodeClay.cc ->
+        is_repair): one lost chunk, its whole column otherwise available,
+        and >= d helpers."""
+        if self.q < 2:
+            return False
+        # the reference requires a single wanted chunk (not merely a single
+        # erased one): multi-chunk wants take the full-decode path so every
+        # wanted chunk comes back whole (ErasureCodeClay.cc -> is_repair)
+        if len(set(want_to_read)) != 1:
+            return False
+        want = set(want_to_read) - set(available)
+        if len(want) != 1:
+            return False
+        lost = self._node(next(iter(want)))
+        y0 = lost // self.q
+        for x in range(self.q):
+            node = y0 * self.q + x
+            if node == lost:
+                continue
+            c = self._chunk(node)
+            if c is not None and c not in available:
+                return False
+        avail_real = [c for c in available
+                      if c != self._chunk(lost)]
+        return len(avail_real) >= self.d
+
+    def _repair_planes(self, lost_node: int) -> List[int]:
+        x0, y0 = lost_node % self.q, lost_node // self.q
+        return [z for z in range(self.sub_chunk_no)
+                if self._digit(z, y0) == x0]
+
+    @staticmethod
+    def _runs(indices: List[int]) -> List[Tuple[int, int]]:
+        """Sorted indices -> contiguous (offset, length) runs."""
+        runs: List[Tuple[int, int]] = []
+        for i in indices:
+            if runs and runs[-1][0] + runs[-1][1] == i:
+                runs[-1] = (runs[-1][0], runs[-1][1] + 1)
+            else:
+                runs.append((i, 1))
+        return runs
+
+    def _pick_helpers(self, lost_node: int, available: set) -> List[int]:
+        """Exactly d helper chunk ids: the lost column first, then lowest
+        chunk ids (ErasureCodeClay.cc -> minimum_to_decode helper pick)."""
+        y0 = lost_node // self.q
+        column = []
+        for x in range(self.q):
+            node = y0 * self.q + x
+            c = self._chunk(node)
+            if node != lost_node and c is not None and c in available:
+                column.append(c)
+        rest = [c for c in sorted(available)
+                if c not in column and c != self._chunk(lost_node)]
+        helpers = column + rest
+        return sorted(helpers[:self.d]) if len(helpers) >= self.d else helpers
+
+    def minimum_to_decode(
+        self, want_to_read: set, available: set,
+    ) -> Dict[int, List[Tuple[int, int]]]:
+        if set(want_to_read) <= set(available):
+            return {c: [(0, self.sub_chunk_no)] for c in want_to_read}
+        if self.is_repair(want_to_read, available):
+            lost = self._node(next(iter(set(want_to_read) - set(available))))
+            runs = self._runs(self._repair_planes(lost))
+            helpers = self._pick_helpers(lost, set(available))
+            return {c: list(runs) for c in helpers}
+        chosen = self._minimum_to_decode(set(want_to_read), set(available))
+        return {c: [(0, self.sub_chunk_no)] for c in chosen}
+
+    # -- decode -------------------------------------------------------------
+
+    def decode(self, want_to_read: set, chunks: Dict[int, bytes],
+               chunk_size: int) -> Dict[int, bytes]:
+        want = set(want_to_read)
+        available = set(chunks)
+        if want <= available:
+            return {i: chunks[i] for i in want}
+        if self.is_repair(want, available):
+            return self._repair(want, chunks, chunk_size)
+        return self._decode_full(want, chunks, chunk_size)
+
+    def _decode_full(self, want: set, chunks: Dict[int, bytes],
+                     chunk_size: int) -> Dict[int, bytes]:
+        sub = self.sub_chunk_no
+        sc = chunk_size // sub
+        C = np.zeros((self.n_nodes, sub, sc), dtype=np.uint8)
+        c_known = np.zeros((self.n_nodes, sub), dtype=bool)
+        c_known[self.k:self.k + self.nu, :] = True
+        for c, buf in chunks.items():
+            node = self._node(c)
+            C[node] = np.frombuffer(buf, dtype=np.uint8).reshape(sub, sc)
+            c_known[node, :] = True
+        erased = {self._node(c) for c in range(self.k + self.m)
+                  if c not in chunks}
+        self._decode_layered(C, c_known, erased)
+        return {c: (chunks[c] if c in chunks
+                    else C[self._node(c)].tobytes())
+                for c in want}
+
+    def decode_chunks(self, want_to_read: set, chunks: Dict[int, bytes],
+                      decoded: Dict[int, bytes]) -> Dict[int, bytes]:
+        """Full-chunk decode entry: every buffer must be a whole chunk.
+
+        Sub-chunk partial reads (as requested by the repair branch of
+        minimum_to_decode) must go through decode(), whose explicit
+        chunk_size argument disambiguates partial helper buffers."""
+        sizes = {len(b) for b in chunks.values()}
+        if len(sizes) != 1:
+            raise IOError(
+                f"decode_chunks requires equal full-size chunk buffers, "
+                f"got sizes {sorted(sizes)}; use decode(chunk_size=...) "
+                f"for sub-chunk repair reads")
+        chunk_size = len(next(iter(chunks.values())))
+        out = self.decode(set(range(self.k + self.m)) - set(chunks)
+                          | set(want_to_read), dict(chunks), chunk_size)
+        decoded.update(out)
+        return decoded
+
+    def decode_chunks_batch(self, chunks: np.ndarray, available: tuple,
+                            erased: tuple) -> np.ndarray:
+        """(batch, len(available), chunk) -> (batch, len(erased), chunk)
+        via a probed per-pattern composite decode matrix."""
+        M = self._probe_decode_matrix(tuple(available), tuple(erased))
+        b, na, chunk = chunks.shape
+        sub = self.sub_chunk_no
+        sc = chunk // sub
+        x = np.ascontiguousarray(chunks).reshape(b, na * sub, sc)
+        y = regionops.matrix_encode(x, M, W)
+        return y.reshape(b, len(erased), chunk)
+
+    # -- repair (ErasureCodeClay.cc -> repair / repair_one_lost_chunk) ------
+
+    def _repair(self, want: set, chunks: Dict[int, bytes],
+                chunk_size: int) -> Dict[int, bytes]:
+        lost_chunk = next(iter(want - set(chunks)))
+        lost = self._node(lost_chunk)
+        sc = chunk_size // self.sub_chunk_no
+        helpers = self._pick_helpers(lost, set(chunks))
+        repaired = self._repair_lost(
+            lost, helpers,
+            {h: np.frombuffer(chunks[h], dtype=np.uint8) for h in helpers},
+            sc)
+        out = {lost_chunk: repaired.tobytes()}
+        for c in want & set(chunks):
+            out[c] = chunks[c]
+        return out
+
+    def _repair_lost(self, lost: int, helpers: List[int],
+                     helper_bufs: Dict[int, np.ndarray],
+                     sc: int) -> np.ndarray:
+        """Repair node ``lost`` from helper sub-chunks; each helper buffer
+        is either the full chunk or just the repair planes concatenated.
+        Returns the (sub_chunk_no, sc) repaired chunk."""
+        q, sub = self.q, self.sub_chunk_no
+        x0, y0 = lost % q, lost // q
+        planes = self._repair_planes(lost)
+        n_rp = len(planes)
+        helper_nodes = {self._node(h) for h in helpers}
+        aloof = {n for n in range(self.n_nodes)
+                 if self._chunk(n) is not None
+                 and n != lost and n not in helper_nodes
+                 and self._chunk(n) not in helpers}
+        C = np.zeros((self.n_nodes, sub, sc), dtype=np.uint8)
+        c_known = np.zeros((self.n_nodes, sub), dtype=bool)
+        # virtual chunks: zero everywhere, known everywhere
+        for n in range(self.k, self.k + self.nu):
+            c_known[n, :] = True
+        for h in helpers:
+            node = self._node(h)
+            buf = helper_bufs[h]
+            if buf.size == sub * sc:  # full chunk passed: slice planes
+                arr = buf.reshape(sub, sc)[planes]
+            else:
+                arr = buf.reshape(n_rp, sc)
+            C[node, planes] = arr
+            c_known[node, planes] = True
+        # per-plane MDS erasures: lost + aloof + rest of the lost column
+        col = {y0 * q + x for x in range(q)} - {lost}
+        mds_erased = frozenset({lost} | aloof | col)
+        if len(mds_erased) != self.m:
+            raise IOError(
+                f"repair infeasible: {len(mds_erased)} unknowns per plane "
+                f"!= m={self.m} (helpers={helpers})")
+        er = tuple(sorted(mds_erased))
+        dm = self._plane_decode_matrix(er)
+        survivors = [n for n in range(self.n_nodes) if n not in mds_erased]
+        # order repair planes by aloof-dot intersection score
+        U = np.zeros_like(C)
+        u_known = np.zeros((self.n_nodes, sub), dtype=bool)
+        orders = {z: sum(1 for n in aloof
+                         if self._digit(z, n // q) == n % q)
+                  for z in planes}
+        for iscore in range(max(orders.values()) + 1 if planes else 0):
+            for z in planes:
+                if orders[z] != iscore:
+                    continue
+                self._compute_u_plane(C, U, u_known, c_known, z, mds_erased)
+                solved = regionops.matrix_encode(U[survivors, z], dm, W)
+                for i, node in enumerate(er):
+                    U[node, z] = solved[i]
+                    u_known[node, z] = True
+        # lost chunk: repair planes are dots (C == U); other planes couple
+        # with a lost-column vertex solved above
+        out = np.zeros((sub, sc), dtype=np.uint8)
+        for z in range(sub):
+            xp = self._digit(z, y0)
+            if xp == x0:
+                out[z] = U[lost, z]
+                continue
+            u_node = y0 * q + xp
+            z_rp = z + (x0 - xp) * self._powq[y0]  # the paired repair plane
+            # C(v2) = pft[s2,0] U_slot0 + pft[s2,1] U_slot1 with
+            # v2 = (u_node, z_rp), v1 = (lost, z); U(v2) known, solve
+            # U(v1) then couple to get C(v1).
+            s1, s2 = self._slots(lost, u_node)
+            num = C[u_node, z_rp] ^ _mul(self.pft[s2, s2], U[u_node, z_rp])
+            u_lost = _mul(gf_inv(int(self.pft[s2, s1]), W), num)
+            u0, u1 = ((u_lost, U[u_node, z_rp]) if s1 == 0
+                      else (U[u_node, z_rp], u_lost))
+            out[z] = _mul(self.pft[s1, 0], u0) ^ _mul(self.pft[s1, 1], u1)
+        return out
+
+    # -- device-resident paths (bench hot loop) -----------------------------
+
+    def _static(self, key: tuple, M: np.ndarray):
+        from ...ops.xla_ops import matrix_to_static
+        ms = self._linear_cache.get(key)
+        if ms is None:
+            ms = matrix_to_static(M)
+            self._linear_cache[key] = ms
+        return ms
+
+    def encode_chunks_jax(self, data):
+        """(batch, k, chunk) uint8 device array -> (batch, m, chunk) parity
+        on device: ONE sparse composite-matrix application (the probed
+        matrix has ~k*2^t nonzeros per row, not k*sub — the layered
+        structure survives composition)."""
+        from ...ops.xla_ops import apply_matrix_xla
+        M = self._probe_encode_matrix()
+        ms = self._static(("encode_static",), M)
+        b, k, chunk = data.shape
+        sub = self.sub_chunk_no
+        x = data.reshape(b, k * sub, chunk // sub)
+        y = apply_matrix_xla(x, ms, W)
+        return y.reshape(b, self.m, chunk)
+
+    def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
+        """(batch, len(available), chunk) device array ->
+        (batch, len(erased), chunk)."""
+        from ...ops.xla_ops import apply_matrix_xla
+        M = self._probe_decode_matrix(tuple(available), tuple(erased))
+        ms = self._static(("decode_static", available, erased), M)
+        b, na, chunk = chunks.shape
+        sub = self.sub_chunk_no
+        x = chunks.reshape(b, na * sub, chunk // sub)
+        y = apply_matrix_xla(x, ms, W)
+        return y.reshape(b, len(erased), chunk)
+
+    # -- probed composite matrices (TPU batch path) -------------------------
+
+    def _probe_encode_matrix(self) -> np.ndarray:
+        """(m*sub, k*sub) composite encode matrix via impulse probing."""
+        M = self._linear_cache.get(("encode",))
+        if M is not None:
+            return M
+        k, sub = self.k, self.sub_chunk_no
+        width = k * sub
+        C = np.zeros((self.n_nodes, sub, width), dtype=np.uint8)
+        c_known = np.zeros((self.n_nodes, sub), dtype=bool)
+        for i in range(k):
+            for s in range(sub):
+                C[i, s, i * sub + s] = 1
+            c_known[i, :] = True
+        c_known[k:k + self.nu, :] = True
+        coding = set(range(self.k + self.nu, self.n_nodes))
+        self._decode_layered(C, c_known, coding)
+        M = np.concatenate(
+            [C[self.k + self.nu + j] for j in range(self.m)],
+            axis=0).astype(np.int64)
+        self._linear_cache[("encode",)] = M
+        return M
+
+    def _probe_decode_matrix(self, available: Tuple[int, ...],
+                             erased: Tuple[int, ...]) -> np.ndarray:
+        """(len(erased)*sub, len(available)*sub) composite decode matrix."""
+        key = ("decode", available, erased)
+        M = self._linear_cache.get(key)
+        if M is not None:
+            return M
+        sub = self.sub_chunk_no
+        width = len(available) * sub
+        chunks = {}
+        for t, c in enumerate(available):
+            arr = np.zeros((sub, width), dtype=np.uint8)
+            for s in range(sub):
+                arr[s, t * sub + s] = 1
+            chunks[c] = arr.tobytes()
+        out = self._decode_full(set(erased), chunks, sub * width)
+        M = np.concatenate(
+            [np.frombuffer(out[c], dtype=np.uint8).reshape(sub, width)
+             for c in erased], axis=0).astype(np.int64)
+        self._linear_cache[key] = M
+        return M
+
+
+class ErasureCodePluginClay(ErasureCodePlugin):
+    """ErasureCodePluginClay.cc -> factory."""
+
+    def factory(self, profile: ErasureCodeProfile,
+                directory=None) -> ErasureCodeClay:
+        interface = ErasureCodeClay()
+        interface.init(profile)
+        return interface
+
+
+def __erasure_code_init__(plugin_name: str, registry) -> None:
+    registry.add(plugin_name, ErasureCodePluginClay())

@@ -1,0 +1,184 @@
+"""Clay plugin tests — mirrors src/test/erasure-code/TestErasureCodeClay.cc:
+round-trip over exhaustive erasure patterns, sub-chunk repair semantics
+(bandwidth < k reads), minimum_to_decode ranges, batched path pinning."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+
+
+def make(k, m, d, **extra):
+    profile = {"k": str(k), "m": str(m), "d": str(d), **extra}
+    return ErasureCodePluginRegistry.instance().factory("clay", profile)
+
+
+GEOMETRIES = [
+    (2, 2, 3),   # q=2 t=2 sub=4
+    (4, 2, 5),   # q=2 t=3 sub=8
+    (3, 3, 5),   # q=3 t=2 sub=9
+    (4, 3, 6),   # q=3 nu=2 t=3 sub=27 (virtual chunks)
+    (4, 2, 4),   # d=k degenerate: q=1, sub=1 (plain MDS)
+]
+
+
+def roundtrip_data(ec, nbytes, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=nbytes, dtype=np.uint8).tobytes()
+
+
+@pytest.mark.parametrize("k,m,d", GEOMETRIES)
+def test_roundtrip_exhaustive_erasures(k, m, d):
+    ec = make(k, m, d)
+    n = k + m
+    data = roundtrip_data(ec, 1000 + 13 * k)
+    encoded = ec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    assert chunk_size % ec.get_sub_chunk_count() == 0
+    # systematic: data chunks carry the original bytes
+    assert b"".join(encoded[i] for i in range(k))[:len(data)] == data
+    for nerase in range(1, m + 1):
+        for erased in itertools.combinations(range(n), nerase):
+            avail = {i: encoded[i] for i in range(n) if i not in erased}
+            decoded = ec.decode(set(erased), avail, chunk_size)
+            for c in erased:
+                assert decoded[c] == encoded[c], (erased, c)
+
+
+@pytest.mark.parametrize("k,m,d", [(2, 2, 3), (4, 2, 5), (3, 3, 5),
+                                   (4, 3, 6)])
+def test_single_chunk_repair_bandwidth(k, m, d):
+    """Repair of one chunk reads sub_chunk_no/q sub-chunks from each of d
+    helpers — strictly fewer bytes than a k-chunk full decode."""
+    ec = make(k, m, d)
+    n, q, sub = k + m, ec.q, ec.get_sub_chunk_count()
+    data = roundtrip_data(ec, 2000)
+    encoded = ec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    sc = chunk_size // sub
+    for lost in range(n):
+        avail = set(range(n)) - {lost}
+        minimum = ec.minimum_to_decode({lost}, avail)
+        assert len(minimum) == d
+        read_sub = sum(length for runs in minimum.values()
+                       for (_, length) in runs)
+        assert read_sub == d * (sub // q)
+        assert read_sub * sc < k * chunk_size  # beats full-decode reads
+        # feed ONLY the sub-chunks the plan asked for
+        partial = {}
+        for c, runs in minimum.items():
+            full = np.frombuffer(encoded[c], dtype=np.uint8).reshape(sub, sc)
+            idx = [z for (off, ln) in runs for z in range(off, off + ln)]
+            partial[c] = np.ascontiguousarray(full[idx]).tobytes()
+        out = ec.decode({lost}, partial, chunk_size)
+        assert out[lost] == encoded[lost], lost
+
+
+def test_repair_with_d_less_than_max():
+    """d < k+m-1: aloof (non-helper) nodes exercised."""
+    ec = make(4, 3, 5)  # q=2, aloof count = (k+m-1) - d = 1
+    n = 7
+    data = roundtrip_data(ec, 3000, seed=3)
+    encoded = ec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    for lost in range(n):
+        avail = set(range(n)) - {lost}
+        if not ec.is_repair({lost}, avail):
+            continue
+        minimum = ec.minimum_to_decode({lost}, avail)
+        assert len(minimum) == 5
+        out = ec.decode({lost}, {c: encoded[c] for c in minimum},
+                        chunk_size)
+        assert out[lost] == encoded[lost]
+
+
+@pytest.mark.parametrize("k,m,d", [(4, 2, 5), (3, 3, 5)])
+def test_batched_paths_match_scalar(k, m, d):
+    ec = make(k, m, d)
+    n = k + m
+    sub = ec.get_sub_chunk_count()
+    rng = np.random.default_rng(7)
+    batch, chunk = 3, sub * 8
+    data = rng.integers(0, 256, size=(batch, k, chunk), dtype=np.uint8)
+    parity = ec.encode_chunks_batch(data)
+    assert parity.shape == (batch, m, chunk)
+    for b in range(batch):
+        chunks = {i: data[b, i].tobytes() for i in range(k)}
+        enc = ec.encode_chunks(set(range(n)), chunks)
+        for j in range(m):
+            assert parity[b, j].tobytes() == enc[k + j], (b, j)
+    # batched decode for one fixed pattern
+    erased = (0, k)  # a data chunk and a parity chunk
+    available = tuple(i for i in range(n) if i not in erased)
+    full = np.zeros((batch, n, chunk), dtype=np.uint8)
+    full[:, :k] = data
+    full[:, k:] = parity
+    rec = ec.decode_chunks_batch(
+        np.ascontiguousarray(full[:, list(available)]), available, erased)
+    for t, c in enumerate(erased):
+        np.testing.assert_array_equal(rec[:, t], full[:, c])
+
+
+def test_minimum_to_decode_full_when_not_repair():
+    ec = make(4, 2, 5)
+    sub = ec.get_sub_chunk_count()
+    # two erasures -> no single-chunk repair; full-chunk reads of k chunks
+    minimum = ec.minimum_to_decode({0, 1}, {2, 3, 4, 5})
+    assert all(runs == [(0, sub)] for runs in minimum.values())
+    assert len(minimum) == 4
+    # single erasure takes the sub-chunk repair path instead
+    minimum = ec.minimum_to_decode({0}, {1, 2, 3, 4, 5})
+    assert len(minimum) == 5
+    assert all(sum(ln for _, ln in runs) == sub // ec.q
+               for runs in minimum.values())
+
+
+def test_multi_chunk_want_takes_full_decode_path():
+    """want={available chunk, erased chunk} must NOT route to sub-chunk
+    repair: every wanted chunk comes back whole (reference is_repair
+    requires want_to_read.size() == 1)."""
+    ec = make(4, 3, 5)
+    n = 7
+    data = roundtrip_data(ec, 1500, seed=11)
+    encoded = ec.encode(set(range(n)), data)
+    chunk_size = len(encoded[0])
+    avail = set(range(6))  # chunk 6 erased
+    assert not ec.is_repair({0, 6}, avail)
+    minimum = ec.minimum_to_decode({0, 6}, avail)
+    sub = ec.get_sub_chunk_count()
+    assert all(runs == [(0, sub)] for runs in minimum.values())
+    out = ec.decode({0, 6}, {c: encoded[c] for c in minimum}, chunk_size)
+    assert out[0] == encoded[0] and out[6] == encoded[6]
+    # decode_chunks refuses mixed partial/full buffers
+    import pytest as _pytest
+    with _pytest.raises(IOError):
+        ec.decode_chunks({6}, {0: encoded[0], 1: encoded[1][:8]}, {})
+
+
+def test_profile_validation():
+    with pytest.raises(ValueError):
+        make(4, 2, 7)  # d > k+m-1
+    with pytest.raises(ValueError):
+        make(4, 2, 3)  # d < k
+    with pytest.raises(ValueError):
+        make(4, 2, 5, scalar_mds="nope")
+    with pytest.raises(ValueError):
+        make(4, 2, 5, scalar_mds="jerasure", technique="cauchy_good")
+    # isa cauchy is a matrix technique: allowed
+    ec = make(4, 2, 5, scalar_mds="isa", technique="cauchy")
+    data = roundtrip_data(ec, 500)
+    enc = ec.encode(set(range(6)), data)
+    dec = ec.decode({0, 5}, {i: enc[i] for i in (1, 2, 3, 4)},
+                    len(enc[0]))
+    assert dec[0] == enc[0] and dec[5] == enc[5]
+
+
+def test_sub_chunk_count_and_chunk_size():
+    ec = make(4, 2, 5)
+    assert ec.get_sub_chunk_count() == 8
+    for width in (1, 100, 4096, 65536):
+        cs = ec.get_chunk_size(width)
+        assert cs * 4 >= width
+        assert cs % 8 == 0
