@@ -517,8 +517,14 @@ class ErasureCodeClay(ErasureCode):
             buf = helper_bufs[h]
             if buf.size == sub * sc:  # full chunk passed: slice planes
                 arr = buf.reshape(sub, sc)[planes]
-            else:
+            elif buf.size == n_rp * sc:
                 arr = buf.reshape(n_rp, sc)
+            else:
+                raise IOError(
+                    f"repair helper chunk {h} has {buf.size} bytes; "
+                    f"expected a full chunk ({sub * sc}) or the "
+                    f"{n_rp} repair sub-chunks ({n_rp * sc}) for "
+                    f"chunk_size {sub * sc}")
             C[node, planes] = arr
             c_known[node, planes] = True
         # per-plane MDS erasures: lost + aloof + rest of the lost column

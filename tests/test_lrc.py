@@ -1,0 +1,163 @@
+"""LRC plugin tests — mirrors src/test/erasure-code/TestErasureCodeLrc.cc:
+kml generation, layer parsing/validation, locality (single-chunk repair
+reads only the local group), round-trip, batch pinning."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+
+
+def make(**profile):
+    profile = {k.replace("_", "-") if k.startswith("crush") else k: str(v)
+               for k, v in profile.items()}
+    return ErasureCodePluginRegistry.instance().factory("lrc", profile)
+
+
+DOC_LAYERS = '[["_cDD_cDD",""],["cDDD____",""],["____cDDD",""]]'
+
+
+def test_kml_generation_matches_doc_example():
+    """k=4 m=2 l=3 == the documented low-level mapping/layers form."""
+    ec = make(k=4, m=2, l=3)
+    assert ec.mapping == "__DD__DD"
+    assert [L.mapping for L in ec.layers] == [
+        "_cDD_cDD", "cDDD____", "____cDDD"]
+    ec2 = make(mapping="__DD__DD", layers=DOC_LAYERS)
+    data = np.random.default_rng(0).integers(
+        0, 256, 4096, dtype=np.uint8).tobytes()
+    n = ec.get_chunk_count()
+    assert n == 8 and ec.get_data_chunk_count() == 4
+    e1 = ec.encode(set(range(n)), data)
+    e2 = ec2.encode(set(range(n)), data)
+    assert e1 == e2
+
+
+@pytest.mark.parametrize("profile", [
+    dict(k=4, m=2, l=3),
+    dict(k=8, m=4, l=3),
+    dict(mapping="__DD__DD", layers=DOC_LAYERS),
+])
+def test_roundtrip(profile):
+    ec = make(**profile)
+    n = ec.get_chunk_count()
+    k = ec.get_data_chunk_count()
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+    enc = ec.encode(set(range(n)), data)
+    cs = len(enc[next(iter(enc))])
+    assert ec.decode_concat(dict(enc))[:len(data)] == data
+    # every single erasure must round-trip; double erasures must either
+    # round-trip or raise IOError (not every pattern is LRC-recoverable),
+    # and the read plan must only name chunks that are actually available
+    for nerase in (1, 2):
+        for erased in itertools.combinations(range(n), nerase):
+            avail_ids = set(range(n)) - set(erased)
+            try:
+                minimum = ec.minimum_to_decode(set(erased), avail_ids)
+            except IOError:
+                assert nerase > 1, f"single erasure {erased} unrecoverable"
+                continue
+            assert set(minimum) <= avail_ids, (erased, sorted(minimum))
+            dec = ec.decode(set(erased),
+                            {c: enc[c] for c in minimum}, cs)
+            for c in erased:
+                assert dec[c] == enc[c], erased
+
+
+def test_locality_single_erasure_reads_fewer_than_k():
+    """The headline LRC property: one lost chunk repairs from its local
+    group (l chunks), not from k chunks."""
+    ec = make(k=8, m=4, l=3)  # groups of 3 + local parity
+    n = ec.get_chunk_count()
+    data = np.random.default_rng(2).integers(
+        0, 256, 8192, dtype=np.uint8).tobytes()
+    enc = ec.encode(set(range(n)), data)
+    cs = len(enc[next(iter(enc))])
+    for lost in range(n):
+        avail = set(range(n)) - {lost}
+        minimum = ec.minimum_to_decode({lost}, avail)
+        assert len(minimum) == 3, (lost, sorted(minimum))  # l reads, not k=8
+        dec = ec.decode({lost}, {c: enc[c] for c in minimum}, cs)
+        assert dec[lost] == enc[lost], lost
+
+
+def test_multi_erasure_falls_back_to_global_layer():
+    ec = make(k=4, m=2, l=3)
+    n = 8
+    data = b"\xa5" * 1024
+    enc = ec.encode(set(range(n)), data)
+    cs = len(enc[0])
+    # erase a whole local group's data+global: needs the global layer
+    for erased in [(1, 2), (2, 3), (1, 2, 3)]:
+        avail = {i: enc[i] for i in range(n) if i not in erased}
+        try:
+            dec = ec.decode(set(erased), avail, cs)
+        except IOError:
+            continue  # not all patterns are recoverable for LRC
+        for c in erased:
+            assert dec[c] == enc[c], erased
+
+
+def test_batched_paths_match_scalar():
+    ec = make(k=4, m=2, l=3)
+    n, k = 8, 4
+    rng = np.random.default_rng(3)
+    batch, cs = 4, 256
+    data = rng.integers(0, 256, size=(batch, k, cs), dtype=np.uint8)
+    parity = ec.encode_chunks_batch(data)
+    _, parity_pos = ec._probe_encode_matrix()
+    for b in range(batch):
+        chunks = {p: data[b, i].tobytes()
+                  for i, p in enumerate(ec.get_chunk_mapping())}
+        enc = ec.encode_chunks(set(range(n)), chunks)
+        for t, p in enumerate(parity_pos):
+            assert parity[b, t].tobytes() == enc[p], (b, p)
+    # batched decode of a fixed pattern
+    full = {p: None for p in range(n)}
+    erased = (2, 6)
+    available = tuple(p for p in range(n) if p not in erased)
+    allb = np.zeros((batch, n, cs), dtype=np.uint8)
+    for b in range(batch):
+        chunks = {p: data[b, i].tobytes()
+                  for i, p in enumerate(ec.get_chunk_mapping())}
+        enc = ec.encode_chunks(set(range(n)), chunks)
+        for p in range(n):
+            allb[b, p] = np.frombuffer(enc[p], dtype=np.uint8)
+    rec = ec.decode_chunks_batch(
+        np.ascontiguousarray(allb[:, list(available)]), available, erased)
+    for t, c in enumerate(erased):
+        np.testing.assert_array_equal(rec[:, t], allb[:, c])
+
+
+def test_profile_validation():
+    with pytest.raises(ValueError):
+        make(k=4, m=2, l=4)  # (k+m) % l != 0
+    with pytest.raises(ValueError):
+        make(k=4, m=2, l=3, mapping="__DD__DD")  # kml + low-level mix
+    with pytest.raises(ValueError):
+        make(mapping="__DD__DD")  # layers missing
+    with pytest.raises(ValueError):
+        make(mapping="__DD__DD", layers="not json")
+    with pytest.raises(ValueError):
+        make(mapping="__DD__DD", layers='[["_cDD",""]]')  # length mismatch
+    with pytest.raises(ValueError):
+        make(mapping="__DD__DD",
+             layers='[["_cDD_cDD",""]]')  # positions 0/4 uncovered
+    with pytest.raises(ValueError):
+        make(mapping="XXDD__DD", layers=DOC_LAYERS)  # bad mapping chars
+
+
+def test_layer_profile_override():
+    """Layers can select their own technique/plugin."""
+    ec = make(mapping="__DD__DD",
+              layers='[["_cDD_cDD","plugin=isa technique=cauchy"],'
+                     '["cDDD____",""],["____cDDD",""]]')
+    data = b"\x5a" * 2048
+    n = 8
+    enc = ec.encode(set(range(n)), data)
+    cs = len(enc[0])
+    dec = ec.decode({2}, {i: enc[i] for i in range(n) if i != 2}, cs)
+    assert dec[2] == enc[2]
