@@ -1,0 +1,92 @@
+"""crushtool-equivalent CLI — src/tools/crushtool.cc.
+
+Supported surface (the --test path is the north-star bulk-remap metric,
+SURVEY.md §6 row 5):
+
+  python -m ceph_tpu.bench.crushtool -i map.json --test \\
+      --rule 0 --num-rep 3 --min-x 0 --max-x 999999 \\
+      --show-statistics [--show-mappings] [--engine bulk|host] \\
+      [--weight DEV W]...
+  python -m ceph_tpu.bench.crushtool --build-two-level H D -o map.json
+  python -m ceph_tpu.bench.crushtool -d map.json      (decompile: print)
+
+Output format follows crushtool --test --show-statistics: per-device
+placement counts plus a mappings/s line (the benchmark figure).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..crush.builder import CrushBuilder
+from ..crush.compiler import compile_map, decompile
+from ..crush.tester import test_rule
+from ..crush.types import CRUSH_ITEM_NONE
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="crushtool",
+                                description=__doc__.split("\n")[0])
+    p.add_argument("-i", "--infn", help="input map (JSON)")
+    p.add_argument("-o", "--outfn", help="output map (JSON)")
+    p.add_argument("-d", "--decompile", metavar="MAP",
+                   help="print the JSON text of MAP")
+    p.add_argument("--build-two-level", nargs=2, type=int,
+                   metavar=("HOSTS", "DEVS"),
+                   help="build a root->host->osd straw2 map")
+    p.add_argument("--test", action="store_true",
+                   help="run mapping sweep (CrushTester)")
+    p.add_argument("--rule", type=int, default=0)
+    p.add_argument("--num-rep", type=int, default=3)
+    p.add_argument("--min-x", type=int, default=0)
+    p.add_argument("--max-x", type=int, default=1023)
+    p.add_argument("--show-statistics", action="store_true")
+    p.add_argument("--show-mappings", action="store_true")
+    p.add_argument("--engine", choices=("host", "bulk"), default="bulk")
+    p.add_argument("--weight", nargs=2, action="append", default=[],
+                   metavar=("DEV", "W"),
+                   help="override device weight (float, 1.0 = in)")
+    args = p.parse_args(argv)
+
+    if args.decompile:
+        cmap = compile_map(open(args.decompile).read())
+        print(decompile(cmap))
+        return 0
+
+    cmap = None
+    if args.infn:
+        cmap = compile_map(open(args.infn).read())
+    elif args.build_two_level:
+        h, d = args.build_two_level
+        b = CrushBuilder()
+        root = b.build_two_level(h, d)
+        b.add_simple_rule(0, root, "host", firstn=True, name="replicated")
+        b.add_simple_rule(1, root, "host", firstn=False, name="erasure")
+        cmap = b.map
+    if cmap is None:
+        p.error("need -i MAP or --build-two-level")
+
+    if args.outfn:
+        with open(args.outfn, "w") as f:
+            f.write(decompile(cmap))
+        print(f"wrote {args.outfn}", file=sys.stderr)
+
+    if args.test:
+        weight = cmap.device_weights()
+        for dev, w in args.weight:
+            weight[int(dev)] = int(float(w) * 0x10000)
+        res = test_rule(cmap, args.rule, args.num_rep, args.min_x,
+                        args.max_x, weight=weight, engine=args.engine,
+                        keep_mappings=args.show_mappings)
+        if args.show_mappings:
+            for i, row in enumerate(res.mappings):
+                devs = [int(d) for d in row if d != CRUSH_ITEM_NONE]
+                print(f"CRUSH rule {args.rule} x {args.min_x + i} {devs}")
+        if args.show_statistics or not args.show_mappings:
+            print(res.report())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

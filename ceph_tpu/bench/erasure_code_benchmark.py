@@ -158,25 +158,65 @@ class ErasureCodeBench:
 
     # -- decode (ceph_erasure_code_benchmark.cc -> decode()) ---------------
 
-    def _erasure_patterns(self, n: int) -> List[tuple]:
+    def _erasure_patterns(self, ec, n: int) -> List[tuple]:
         """Sequence of erased-chunk tuples, one per iteration.
 
         Mirrors the reference: --erased pins an explicit set; exhaustive
-        cycles all C(n, erasures) combinations; random draws per iteration.
-        """
+        cycles all C(n, erasures) combinations; random draws per
+        iteration.  Patterns the code cannot decode (possible for
+        non-MDS codes like lrc/shec) are skipped, like the reference's
+        decode() error-continue."""
         a = self.args
+
+        def decodable(pat: tuple) -> bool:
+            try:
+                ec.minimum_to_decode(set(pat),
+                                     set(range(n)) - set(pat))
+                return True
+            except IOError:
+                return False
+
         if a.erasures > n:
             raise ValueError(
                 f"--erasures {a.erasures} exceeds chunk count {n}")
         if a.erased:
             return [tuple(sorted(a.erased))] * a.iterations
         if a.erasures_generation == "exhaustive":
-            combos = list(itertools.combinations(range(n), a.erasures))
+            combos = [c for c in
+                      itertools.combinations(range(n), a.erasures)
+                      if decodable(c)]
+            if not combos:
+                raise ValueError(
+                    f"no decodable {a.erasures}-erasure pattern")
             reps = (a.iterations + len(combos) - 1) // len(combos)
             return (combos * reps)[:a.iterations]
         rng = np.random.default_rng(a.seed + 1)
-        return [tuple(sorted(rng.choice(n, size=a.erasures, replace=False)))
-                for _ in range(a.iterations)]
+        out: List[tuple] = []
+        attempts = 0
+        while len(out) < a.iterations:
+            pat = tuple(sorted(rng.choice(n, size=a.erasures,
+                                          replace=False)))
+            attempts += 1
+            if decodable(pat):
+                out.append(pat)
+            elif attempts > 100 * a.iterations:
+                raise ValueError(
+                    f"could not draw decodable {a.erasures}-erasure "
+                    f"patterns")
+        return out
+
+    def _place_chunks(self, ec, data: np.ndarray,
+                      parity: np.ndarray) -> np.ndarray:
+        """(B, n, C) with data at get_chunk_mapping() positions (lrc
+        scatters data; every other plugin is identity)."""
+        n = ec.get_chunk_count()
+        mapping = ec.get_chunk_mapping()
+        data_pos = list(mapping) if mapping else list(range(data.shape[1]))
+        parity_pos = [p for p in range(n) if p not in set(data_pos)]
+        allchunks = np.empty((data.shape[0], n, data.shape[2]), np.uint8)
+        allchunks[:, data_pos] = data
+        allchunks[:, parity_pos] = parity
+        return allchunks
 
     def decode(self) -> dict:
         a = self.args
@@ -184,8 +224,8 @@ class ErasureCodeBench:
         n = ec.get_chunk_count()
         data = self._make_batch(ec)
         parity = np.asarray(ec.encode_chunks_batch(data))
-        allchunks = np.concatenate([data, parity], axis=1)  # (B, n, C)
-        patterns = self._erasure_patterns(n)
+        allchunks = self._place_chunks(ec, data, parity)
+        patterns = self._erasure_patterns(ec, n)
 
         if a.device == "jax":
             import jax

@@ -40,13 +40,12 @@ import numpy as np
 
 from ...ops import regionops
 from ..base import ErasureCode
-from ..interface import ErasureCodeProfile
+from ..interface import SIMD_ALIGN, ErasureCodeProfile
 from ..registry import ERASURE_CODE_VERSION, ErasureCodePlugin
 
 __erasure_code_version__ = ERASURE_CODE_VERSION
 
 W = 8
-SIMD_ALIGN = 64
 
 
 class _Layer:
@@ -205,6 +204,11 @@ class ErasureCodeLrc(ErasureCode):
                 sub_profile[key] = value
             sub_profile["k"] = str(len(data_pos))
             sub_profile["m"] = str(len(coding_pos))
+            if int(sub_profile.get("w", W) or W) != W:
+                raise ValueError(
+                    f"layer {lm!r}: w={sub_profile['w']} unsupported — "
+                    f"the whole-chunk linear composite (and batch/device "
+                    f"paths) are GF(2^8) only")
             plugin = sub_profile.pop("plugin")
             code = registry.factory(plugin, sub_profile)
             self.layers.append(_Layer(lm, data_pos, coding_pos, code))
@@ -286,6 +290,8 @@ class ErasureCodeLrc(ErasureCode):
         reads = set(want & available)
         missing = set(want) - known
         layers = sorted(self.layers, key=lambda L: len(L.positions))
+        n = len(self.mapping)
+        expanded = False
         progress = True
         while missing and progress:
             progress = False
@@ -313,6 +319,19 @@ class ErasureCodeLrc(ErasureCode):
                 known |= fixable
                 missing -= fixable
                 progress = True
+            if not progress and not expanded:
+                # a wanted chunk may only be reachable through an
+                # intermediate erased chunk no layer can yet rebuild from
+                # `known`; widen the walk to every erasure so cascades
+                # (local rebuild -> global rebuild) are planned too,
+                # as ErasureCodeLrc::minimum_to_decode walks all erasures
+                expanded = True
+                extra = {p for p in range(n)
+                         if p not in known and p not in missing}
+                if extra:
+                    missing |= extra
+                    progress = True
+        missing &= set(want)  # only wanted chunks must actually land
         if missing:
             raise IOError(
                 f"cannot read {sorted(missing)} from available "
@@ -363,10 +382,11 @@ class ErasureCodeLrc(ErasureCode):
 
     # -- probed composite matrices (TPU batch path) -------------------------
 
-    def _probe_encode_matrix(self) -> np.ndarray:
-        """(m, k) composite: all parity positions from data positions."""
-        M = self._linear_cache.get(("encode",))
-        if M is None:
+    def _probe_encode_matrix(self) -> Tuple[np.ndarray, List[int]]:
+        """((n-k, k) composite matrix, parity position order): every
+        parity position expressed over the k data positions."""
+        hit = self._linear_cache.get(("encode",))
+        if hit is None:
             n, k = len(self.mapping), self.k
             chunks = {}
             for i, pos in enumerate(self.data_positions):
@@ -377,8 +397,9 @@ class ErasureCodeLrc(ErasureCode):
             parity_pos = [p for p in range(n) if p not in chunks]
             M = np.stack([np.frombuffer(out[p], dtype=np.uint8)
                           for p in parity_pos]).astype(np.int64)
-            self._linear_cache[("encode",)] = (M, parity_pos)
-        return self._linear_cache[("encode",)]
+            hit = (M, parity_pos)
+            self._linear_cache[("encode",)] = hit
+        return hit
 
     def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
         """(batch, k, C) -> (batch, n-k, C) parity in position order."""

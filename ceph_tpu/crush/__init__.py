@@ -1,0 +1,32 @@
+"""ceph_tpu.crush — CRUSH placement: rjenkins hash, straw2, crush_do_rule.
+
+Mirrors src/crush/ (hash.{h,c}, crush_ln_table.h, crush.h, builder.c,
+mapper.c, CrushWrapper.{h,cc}, CrushTester.{h,cc}):
+
+- ``hash``    — crush_hash32_* (rjenkins1), array-vectorized (numpy/jax).
+- ``ln``      — crush_ln 16.48 fixed-point log2 + its lookup tables.
+- ``types``   — crush_map / crush_bucket / crush_rule / tunables structs.
+- ``builder`` — bucket construction (uniform/list/tree/straw/straw2),
+  map building and editing (CrushWrapper role).
+- ``mapper``  — host reference crush_do_rule (choose_firstn/indep,
+  chooseleaf, retries, is_out) — the oracle the TPU path is pinned to.
+- ``bulk``    — the TPU-native bulk evaluator: straw2 hierarchies
+  evaluated for millions of inputs at once via vmapped jax.
+- ``tester``  — CrushTester-style mapping sweeps + statistics.
+"""
+
+from .types import (  # noqa: F401
+    CRUSH_ITEM_NONE,
+    Bucket,
+    CrushMap,
+    Rule,
+    Tunables,
+    step_take,
+    step_choose_firstn,
+    step_choose_indep,
+    step_chooseleaf_firstn,
+    step_chooseleaf_indep,
+    step_emit,
+)
+from .builder import CrushBuilder  # noqa: F401
+from .mapper import crush_do_rule  # noqa: F401

@@ -1,0 +1,217 @@
+"""CRUSH map construction — src/crush/builder.c + CrushWrapper.{h,cc}.
+
+crush_make_*_bucket aux-array math (list sums, tree node weights, legacy
+straw scaling) and a CrushWrapper-style convenience layer: named types,
+insert_item, rule creation, and whole-tree builders for tests/benches.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .types import (
+    BUCKET_ALG_IDS,
+    CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_STRAW,
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE,
+    CRUSH_BUCKET_UNIFORM,
+    Bucket,
+    CrushMap,
+    Rule,
+    Tunables,
+    step_chooseleaf_firstn,
+    step_chooseleaf_indep,
+    step_emit,
+    step_take,
+)
+
+
+def _calc_tree_depth(size: int) -> int:
+    """builder.c -> calc_depth: ceil(log2(size)) + 1."""
+    if size <= 1:
+        return 1
+    return (size - 1).bit_length() + 1
+
+
+def _tree_parent(node: int) -> int:
+    """mapper.c tree geometry: parent of node (height = lowest set bit)."""
+    h = (node & -node).bit_length() - 1
+    if (node >> (h + 1)) & 1:
+        return node - (1 << h)
+    return node + (1 << h)
+
+
+def make_tree_aux(weights: Sequence[int]) -> Tuple[List[int], int]:
+    """builder.c -> crush_make_tree_bucket: node_weights + num_nodes.
+
+    Item i sits at node 2i+1; interior nodes accumulate subtree weight.
+    """
+    size = len(weights)
+    depth = _calc_tree_depth(size)
+    num_nodes = 1 << depth
+    node_weights = [0] * num_nodes
+    for i, w in enumerate(weights):
+        node = 2 * i + 1
+        node_weights[node] = w
+        for _ in range(1, depth):
+            node = _tree_parent(node)
+            if node >= num_nodes:
+                break
+            node_weights[node] += w
+    return node_weights, num_nodes
+
+
+def make_list_aux(weights: Sequence[int]) -> List[int]:
+    """builder.c -> crush_make_list_bucket: prefix sums."""
+    sums = []
+    total = 0
+    for w in weights:
+        total += w
+        sums.append(total)
+    return sums
+
+
+def make_straws(weights: Sequence[int]) -> List[int]:
+    """builder.c -> crush_calc_straw (legacy straw scaling, v1).
+
+    Reverse-sorts by weight and scales each straw so the probability of
+    winning matches the weight ratios; items of equal weight share a
+    straw length.  Kept for capability parity; straw2 obsoletes it.
+    """
+    size = len(weights)
+    if size == 0:
+        return []
+    reverse = sorted(range(size), key=lambda i: (-weights[i], i))
+    straws = [0] * size
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        # zero-weight items get zero-length straws (never chosen)
+        straws[reverse[i]] = (int(straw * 0x10000)
+                              if weights[reverse[i]] else 0)
+        i += 1
+        if i == size:
+            break
+        if weights[reverse[i]] == weights[reverse[i - 1]]:
+            continue
+        wbelow += (weights[reverse[i - 1]] - lastw) * numleft
+        for j in range(i, size):
+            if weights[reverse[j]] == weights[reverse[i]]:
+                numleft -= 1
+            else:
+                break
+        wnext = numleft * (weights[reverse[i]] - weights[reverse[i - 1]])
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= (1.0 / pbelow) ** (1.0 / numleft)
+        lastw = weights[reverse[i - 1]]
+    return straws
+
+
+class CrushBuilder:
+    """CrushWrapper-style map construction."""
+
+    def __init__(self, tunables: Optional[Tunables] = None) -> None:
+        self.map = CrushMap()
+        if tunables is not None:
+            self.map.tunables = tunables
+        self._next_bucket = -1
+        self._type_ids: Dict[str, int] = {"osd": 0}
+
+    # -- types / names ------------------------------------------------------
+
+    def add_type(self, type_id: int, name: str) -> None:
+        self.map.type_names[type_id] = name
+        self._type_ids[name] = type_id
+
+    def type_id(self, name) -> int:
+        if isinstance(name, int):
+            return name
+        return self._type_ids[name]
+
+    # -- buckets ------------------------------------------------------------
+
+    def add_bucket(self, alg, type_name, items: Sequence[int],
+                   weights: Optional[Sequence[int]] = None,
+                   bucket_id: Optional[int] = None,
+                   name: Optional[str] = None) -> int:
+        """Create a bucket; weights are 16.16 ints (device weight 1.0 =
+        0x10000).  Items may be devices (>= 0) or other buckets (< 0);
+        bucket items contribute their own total weight by default."""
+        if isinstance(alg, str):
+            alg = BUCKET_ALG_IDS[alg]
+        if bucket_id is None:
+            bucket_id = self._next_bucket
+        self._next_bucket = min(self._next_bucket, bucket_id) - 1
+        if weights is None:
+            weights = [self.map.buckets[i].weight if i < 0 else 0x10000
+                       for i in items]
+        weights = [int(w) for w in weights]
+        items = [int(i) for i in items]
+        b = Bucket(id=bucket_id, type=self.type_id(type_name), alg=alg,
+                   items=items, item_weights=weights, weight=sum(weights))
+        if alg == CRUSH_BUCKET_UNIFORM:
+            if weights and len(set(weights)) != 1:
+                raise ValueError("uniform bucket requires equal weights")
+        elif alg == CRUSH_BUCKET_LIST:
+            b.sum_weights = make_list_aux(weights)
+        elif alg == CRUSH_BUCKET_TREE:
+            b.node_weights, b.num_nodes = make_tree_aux(weights)
+        elif alg == CRUSH_BUCKET_STRAW:
+            b.straws = make_straws(weights)
+        elif alg != CRUSH_BUCKET_STRAW2:
+            raise ValueError(f"unknown bucket alg {alg}")
+        self.map.buckets[bucket_id] = b
+        for it in items:
+            if it >= 0:
+                self.map.max_devices = max(self.map.max_devices, it + 1)
+        if name:
+            self.map.item_names[bucket_id] = name
+        return bucket_id
+
+    # -- rules --------------------------------------------------------------
+
+    def add_rule(self, rule_id: int, steps, name: str = "",
+                 rule_type: int = 1) -> int:
+        self.map.rules[rule_id] = Rule(rule_id=rule_id, type=rule_type,
+                                       steps=list(steps), name=name)
+        return rule_id
+
+    def add_simple_rule(self, rule_id: int, root: int, failure_domain,
+                        n: int = 0, firstn: bool = True,
+                        name: str = "") -> int:
+        """CrushWrapper::add_simple_rule: take root -> chooseleaf over the
+        failure domain -> emit."""
+        ft = self.type_id(failure_domain)
+        choose = (step_chooseleaf_firstn(n, ft) if firstn
+                  else step_chooseleaf_indep(n, ft))
+        return self.add_rule(rule_id, [step_take(root), choose,
+                                       step_emit()], name=name)
+
+    # -- convenience: whole trees -------------------------------------------
+
+    def build_flat(self, n_devices: int, alg="straw2",
+                   weights: Optional[Sequence[int]] = None,
+                   name: str = "root") -> int:
+        """One root bucket holding n devices."""
+        self.add_type(1, "root") if 1 not in self.map.type_names else None
+        return self.add_bucket(alg, 1, list(range(n_devices)), weights,
+                               name=name)
+
+    def build_two_level(self, n_hosts: int, devs_per_host: int,
+                        alg="straw2") -> int:
+        """root -> host -> osd tree (the standard test/bench shape)."""
+        if 1 not in self.map.type_names:
+            self.add_type(1, "host")
+        if 2 not in self.map.type_names:
+            self.add_type(2, "root")
+        hosts = []
+        for h in range(n_hosts):
+            devs = list(range(h * devs_per_host, (h + 1) * devs_per_host))
+            hosts.append(self.add_bucket(alg, "host", devs,
+                                         name=f"host{h}"))
+        return self.add_bucket(alg, "root", hosts, name="root")

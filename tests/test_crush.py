@@ -1,0 +1,247 @@
+"""CRUSH host-reference tests — mirrors src/test/crush/ (CrushWrapper
+tests, crush_weights.cc straw2 distribution checks, crushtool cram
+tests' mapping determinism)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (
+    CRUSH_ITEM_NONE,
+    CrushBuilder,
+    Tunables,
+    crush_do_rule,
+    step_chooseleaf_firstn,
+    step_chooseleaf_indep,
+    step_choose_firstn,
+    step_emit,
+    step_take,
+)
+from ceph_tpu.crush.hash import (
+    crush_hash32,
+    crush_hash32_2,
+    crush_hash32_3,
+    crush_hash32_4,
+    crush_hash32_5,
+)
+from ceph_tpu.crush.ln import LL_TBL, RH_LH_TBL, crush_ln
+from ceph_tpu.crush.tester import test_rule as crush_test_rule
+
+
+class TestHash:
+    def test_scalar_vector_agree(self):
+        xs = np.arange(512, dtype=np.uint32)
+        v2 = crush_hash32_2(xs, np.uint32(17))
+        v3 = crush_hash32_3(xs, np.uint32(17), np.uint32(3))
+        for i in (0, 1, 7, 100, 511):
+            assert int(crush_hash32_2(i, 17)) == int(v2[i])
+            assert int(crush_hash32_3(i, 17, 3)) == int(v3[i])
+
+    def test_all_arities_deterministic_and_distinct(self):
+        a = int(crush_hash32(42))
+        assert a == int(crush_hash32(42))
+        vals = {a, int(crush_hash32_2(42, 1)), int(crush_hash32_3(42, 1, 2)),
+                int(crush_hash32_4(42, 1, 2, 3)),
+                int(crush_hash32_5(42, 1, 2, 3, 4))}
+        assert len(vals) == 5
+        for v in vals:
+            assert 0 <= v <= 0xFFFFFFFF
+
+    def test_avalanche(self):
+        """Flipping one input bit flips ~half the output bits."""
+        flips = []
+        for bit in range(32):
+            a = int(crush_hash32_3(100, 5, 9))
+            b = int(crush_hash32_3(100 ^ (1 << bit), 5, 9))
+            flips.append(bin(a ^ b).count("1"))
+        assert 10 < np.mean(flips) < 22
+
+
+class TestLn:
+    def test_table_known_constants(self):
+        # RH(258)/LH(258) known independently of the generator
+        assert int(RH_LH_TBL[0]) == 1 << 48
+        assert int(RH_LH_TBL[1]) == 0
+        assert int(RH_LH_TBL[2]) == 0xFE03F80FE040
+        assert int(RH_LH_TBL[3]) == 0x2DFCA16DDE1
+        assert len(RH_LH_TBL) == 258 and len(LL_TBL) == 256
+
+    def test_crush_ln_matches_log2(self):
+        u = np.arange(0, 0x10000, dtype=np.int64)
+        r = crush_ln(u)
+        expect = (2.0 ** 44) * np.log2(u + 1.0)
+        assert int(r[0]) == 0
+        assert int(r[-1]) == 1 << 48
+        assert np.all(np.diff(r) >= 0)  # monotone
+        assert np.abs(r - expect).max() < 1 << 30  # table quantization
+
+
+def two_level(n_hosts=4, devs=3, alg="straw2"):
+    b = CrushBuilder()
+    root = b.build_two_level(n_hosts, devs, alg=alg)
+    return b, root
+
+
+class TestDoRule:
+    def test_firstn_distinct_and_complete(self):
+        b, root = two_level(5, 4)
+        b.add_simple_rule(0, root, "host", firstn=True)
+        for x in range(300):
+            r = crush_do_rule(b.map, 0, x, 3)
+            assert len(r) == 3
+            assert len(set(r)) == 3
+            assert len({d // 4 for d in r}) == 3  # distinct hosts
+
+    def test_firstn_deterministic(self):
+        b, root = two_level()
+        b.add_simple_rule(0, root, "host", firstn=True)
+        assert [crush_do_rule(b.map, 0, x, 3) for x in range(50)] == \
+               [crush_do_rule(b.map, 0, x, 3) for x in range(50)]
+
+    def test_indep_holes_and_stability(self):
+        """Marking a device out moves only that position (EC property)."""
+        b, root = two_level(5, 4)
+        b.add_rule(0, [step_take(root), step_chooseleaf_indep(0, 1),
+                       step_emit()])
+        w = b.map.device_weights()
+        w[7] = 0
+        moved = 0
+        checked = 0
+        for x in range(500):
+            r0 = crush_do_rule(b.map, 0, x, 4)
+            r1 = crush_do_rule(b.map, 0, x, 4, weight=w)
+            assert len(r0) == len(r1) == 4
+            for a, c in zip(r0, r1):
+                if a == 7:
+                    assert c != 7
+                    continue
+                checked += 1
+                if a != c:
+                    moved += 1
+        assert moved / checked < 0.05  # positional stability
+
+    def test_straw2_weight_proportionality(self):
+        b = CrushBuilder()
+        b.add_type(1, "root")
+        weights = [0x10000] * 6 + [0x20000] * 2
+        root = b.add_bucket("straw2", "root", list(range(8)), weights)
+        b.add_rule(0, [step_take(root), step_choose_firstn(1, 0),
+                       step_emit()])
+        res = crush_test_rule(b.map, 0, 1, 0, 19999)
+        total = sum(res.device_counts.values())
+        for d in range(6):
+            assert abs(res.device_counts[d] / total - 0.1) < 0.01
+        for d in (6, 7):
+            assert abs(res.device_counts[d] / total - 0.2) < 0.015
+
+    def test_device_reweight_rejection(self):
+        """is_out: weight 0x8000 halves a device's share."""
+        b = CrushBuilder()
+        b.add_type(1, "root")
+        root = b.add_bucket("straw2", "root", list(range(4)))
+        b.add_rule(0, [step_take(root), step_choose_firstn(1, 0),
+                       step_emit()])
+        w = b.map.device_weights()
+        w[0] = 0x8000
+        res = crush_test_rule(b.map, 0, 1, 0, 19999, weight=w)
+        total = sum(res.device_counts.values())
+        assert abs(res.device_counts[0] / total - 0.125 / 0.875) < 0.02
+
+    @pytest.mark.parametrize("alg", ["uniform", "list", "tree", "straw"])
+    def test_legacy_bucket_algorithms(self, alg):
+        """All bucket algorithms place all replicas, distinct, roughly
+        uniformly for equal weights."""
+        b = CrushBuilder()
+        b.add_type(1, "root")
+        root = b.add_bucket(alg, "root", list(range(8)))
+        b.add_rule(0, [step_take(root), step_choose_firstn(0, 0),
+                       step_emit()])
+        res = crush_test_rule(b.map, 0, 3, 0, 2999)
+        assert res.bad_mappings == 0
+        total = sum(res.device_counts.values())
+        assert total == 3000 * 3
+        for d, n in res.device_counts.items():
+            assert abs(n / total - 1 / 8) < 0.04, (alg, d, n)
+
+    def test_tree_weighted(self):
+        b = CrushBuilder()
+        b.add_type(1, "root")
+        weights = [0x10000, 0x10000, 0x20000, 0x40000]
+        root = b.add_bucket("tree", "root", list(range(4)), weights)
+        b.add_rule(0, [step_take(root), step_choose_firstn(1, 0),
+                       step_emit()])
+        res = crush_test_rule(b.map, 0, 1, 0, 15999)
+        total = sum(res.device_counts.values())
+        assert abs(res.device_counts[3] / total - 0.5) < 0.03
+        assert abs(res.device_counts[2] / total - 0.25) < 0.03
+
+    def test_legacy_tunables_still_place(self):
+        b = CrushBuilder(tunables=Tunables.legacy())
+        root = b.build_two_level(4, 3)
+        b.add_simple_rule(0, root, "host", firstn=True)
+        for x in range(200):
+            r = crush_do_rule(b.map, 0, x, 3)
+            assert len(set(r)) == 3
+
+    def test_multi_take_rule(self):
+        """TAKE/CHOOSE/EMIT can repeat (e.g. primary on ssd root)."""
+        b = CrushBuilder()
+        b.add_type(1, "root")
+        r1 = b.add_bucket("straw2", "root", [0, 1, 2])
+        r2 = b.add_bucket("straw2", "root", [3, 4, 5])
+        b.add_rule(0, [step_take(r1), step_choose_firstn(1, 0), step_emit(),
+                       step_take(r2), step_choose_firstn(2, 0),
+                       step_emit()])
+        for x in range(100):
+            r = crush_do_rule(b.map, 0, x, 3)
+            assert len(r) == 3
+            assert r[0] in (0, 1, 2)
+            assert set(r[1:]) <= {3, 4, 5}
+
+    def test_choose_args_weight_set_override(self):
+        """Balancer choose_args: alternate weight set changes placement
+        without touching the map."""
+        from ceph_tpu.crush.types import ChooseArg
+        b = CrushBuilder()
+        b.add_type(1, "root")
+        root = b.add_bucket("straw2", "root", [0, 1, 2, 3])
+        b.add_rule(0, [step_take(root), step_choose_firstn(1, 0),
+                       step_emit()])
+        # zero out device 0 in the alternate weight set
+        ca = {root: ChooseArg(weight_set=[[0, 0x10000, 0x10000, 0x10000]])}
+        res = {}
+        for x in range(500):
+            r = crush_do_rule(b.map, 0, x, 1, choose_args=ca)
+            res[r[0]] = res.get(r[0], 0) + 1
+        assert 0 not in res
+
+
+class TestReviewRegressions:
+    def test_firstn_dedups_dual_homed_leaf(self):
+        """firstn's chooseleaf recursion scans out2[0..outpos): a device
+        reachable under two failure domains must not repeat.  (indep's
+        recursion scans only its own slot — see mapper.py note — so only
+        firstn makes this guarantee.)"""
+        b = CrushBuilder()
+        b.add_type(1, "host")
+        b.add_type(2, "root")
+        h1 = b.add_bucket("straw2", "host", [0, 1, 7])
+        h2 = b.add_bucket("straw2", "host", [2, 3, 7])  # 7 dual-homed
+        h3 = b.add_bucket("straw2", "host", [4, 5])
+        root = b.add_bucket("straw2", "root", [h1, h2, h3])
+        b.add_rule(0, [step_take(root), step_chooseleaf_firstn(0, 1),
+                       step_emit()])
+        for x in range(400):
+            r = crush_do_rule(b.map, 0, x, 3)
+            assert len(r) == len(set(r)), (x, r)
+
+    def test_legacy_straw_zero_weight_never_chosen(self):
+        b = CrushBuilder()
+        b.add_type(1, "root")
+        root = b.add_bucket("straw", "root", [0, 1, 2],
+                            [0x10000, 0, 0x10000])
+        b.add_rule(0, [step_take(root), step_choose_firstn(1, 0),
+                       step_emit()])
+        seen = set()
+        for x in range(2000):
+            seen.update(crush_do_rule(b.map, 0, x, 1))
+        assert 1 not in seen

@@ -1,0 +1,165 @@
+"""Bulk (vmapped) CRUSH evaluator pinned bit-for-bit against the host
+reference mapper over randomized straw2 maps, rules, and reweights."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (
+    CrushBuilder,
+    crush_do_rule,
+    step_chooseleaf_firstn,
+    step_chooseleaf_indep,
+    step_choose_firstn,
+    step_choose_indep,
+    step_emit,
+    step_take,
+)
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+
+bulk = pytest.importorskip("ceph_tpu.crush.bulk")
+
+
+def build(n_hosts, devs, weights=None, seed=None):
+    b = CrushBuilder()
+    if seed is not None:
+        rng = np.random.default_rng(seed)
+        b.add_type(1, "host")
+        b.add_type(2, "root")
+        hosts = []
+        d = 0
+        for h in range(n_hosts):
+            nd = int(rng.integers(1, devs + 1))
+            ws = [int(w) for w in rng.integers(0x8000, 0x30000, nd)]
+            hosts.append(b.add_bucket("straw2", "host",
+                                      list(range(d, d + nd)), ws))
+            d += nd
+        root = b.add_bucket("straw2", "root", hosts)
+    else:
+        root = b.build_two_level(n_hosts, devs)
+    return b, root
+
+
+def pin(b, ruleno, result_max, N=400, weight=None):
+    xs = np.arange(N)
+    out, cnt = bulk.bulk_do_rule(b.map, ruleno, xs, result_max,
+                                 weight=weight)
+    for x in range(N):
+        ref = crush_do_rule(b.map, ruleno, x, result_max, weight=weight)
+        ref = ref + [CRUSH_ITEM_NONE] * (result_max - len(ref))
+        assert list(out[x]) == ref, (x, ref, list(out[x]))
+
+
+STEPS = {
+    "chooseleaf_firstn": lambda r: [step_take(r),
+                                    step_chooseleaf_firstn(0, 1),
+                                    step_emit()],
+    "chooseleaf_indep": lambda r: [step_take(r),
+                                   step_chooseleaf_indep(0, 1),
+                                   step_emit()],
+    "choose_firstn_dev": lambda r: [step_take(r),
+                                    step_choose_firstn(0, 0),
+                                    step_emit()],
+    "choose_indep_dev": lambda r: [step_take(r), step_choose_indep(0, 0),
+                                   step_emit()],
+}
+
+
+@pytest.mark.parametrize("shape", sorted(STEPS))
+def test_bulk_matches_host_regular(shape):
+    b, root = build(4, 3)
+    b.add_rule(0, STEPS[shape](root))
+    pin(b, 0, 3)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("shape", ["chooseleaf_firstn",
+                                   "chooseleaf_indep"])
+def test_bulk_matches_host_irregular_weighted(shape, seed):
+    """Irregular host sizes + random item weights."""
+    b, root = build(5, 4, seed=seed)
+    b.add_rule(0, STEPS[shape](root))
+    pin(b, 0, 3, N=300)
+
+
+def test_bulk_matches_host_with_reweights(subtests=None):
+    b, root = build(5, 4)
+    b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+    b.add_rule(1, STEPS["chooseleaf_indep"](root))
+    w = b.map.device_weights()
+    w[0] = 0
+    w[7] = 0x4000
+    w[13] = 0xC000
+    pin(b, 0, 3, weight=w)
+    pin(b, 1, 4, weight=w)
+
+def test_bulk_matches_host_overload_few_hosts():
+    """numrep > n_hosts: firstn comes up short, indep leaves holes —
+    both must match the reference exactly."""
+    b, root = build(3, 2)
+    b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+    b.add_rule(1, STEPS["chooseleaf_indep"](root))
+    pin(b, 0, 5, N=200)
+    pin(b, 1, 5, N=200)
+
+
+def test_bulk_throughput_exceeds_host():
+    from ceph_tpu.crush.tester import test_rule as crush_test_rule
+    b, root = build(8, 4)
+    b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+    host = crush_test_rule(b.map, 0, 3, 0, 999, engine="host")
+    bulk_res = crush_test_rule(b.map, 0, 3, 0, 99999, engine="bulk")
+    assert bulk_res.bad_mappings == 0
+    assert bulk_res.mappings_per_s > host.mappings_per_s, (
+        host.mappings_per_s, bulk_res.mappings_per_s)
+
+
+def test_bulk_gates_unsupported_shapes():
+    """Maps/rules/tunables outside the fused program's exact-replication
+    envelope must raise (and run on the host engine) rather than
+    silently diverge."""
+    from ceph_tpu.crush import Tunables, step_choose_firstn
+    # chained choose steps
+    b, root = build(4, 3)
+    b.add_rule(0, [step_take(root), step_choose_firstn(3, 1),
+                   step_choose_firstn(1, 0), step_emit()])
+    with pytest.raises(ValueError, match="chained"):
+        bulk.bulk_do_rule(b.map, 0, np.arange(4), 3)
+    # pre-jewel tunables
+    b2, root2 = build(4, 3)
+    b2.map.tunables = Tunables.legacy()
+    b2.add_rule(0, STEPS["chooseleaf_firstn"](root2))
+    with pytest.raises(ValueError, match="tunables"):
+        bulk.bulk_do_rule(b2.map, 0, np.arange(4), 3)
+    # irregular hierarchy (device directly under root next to hosts)
+    from ceph_tpu.crush import CrushBuilder
+    b3 = CrushBuilder()
+    b3.add_type(1, "host")
+    b3.add_type(2, "root")
+    h1 = b3.add_bucket("straw2", "host", [0, 1])
+    root3 = b3.add_bucket("straw2", "root", [h1, 2],
+                          [0x20000, 0x10000])
+    b3.add_rule(0, STEPS["chooseleaf_firstn"](root3))
+    with pytest.raises(ValueError, match="regular"):
+        bulk.bulk_do_rule(b3.map, 0, np.arange(4), 3)
+    # ...and the host engine handles all three
+    from ceph_tpu.crush import crush_do_rule as host
+    assert host(b.map, 0, 0, 3)
+    assert host(b2.map, 0, 0, 3)
+    assert host(b3.map, 0, 0, 3)
+
+
+def test_bulk_matches_host_dual_homed():
+    """A dual-homed device passes the regularity gate; pin bulk == host
+    there too (exercises the leaf-dedup vintage question both ways)."""
+    from ceph_tpu.crush import CrushBuilder
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    h1 = b.add_bucket("straw2", "host", [0, 1, 7])
+    h2 = b.add_bucket("straw2", "host", [2, 3, 7])
+    h3 = b.add_bucket("straw2", "host", [4, 5])
+    root = b.add_bucket("straw2", "root", [h1, h2, h3])
+    b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+    b.add_rule(1, STEPS["chooseleaf_indep"](root))
+    pin(b, 0, 3, N=400)
+    pin(b, 1, 3, N=400)
