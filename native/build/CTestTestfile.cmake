@@ -1,0 +1,10 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/native
+# Build directory: /root/repo/native/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(roundtrip_rs "/root/repo/native/build/ceph_erasure_code_benchmark" "-p" "rs" "-w" "decode" "-i" "4" "-s" "65536" "-P" "k=4" "-P" "m=2" "-e" "2" "-d" "/root/repo/native/build")
+set_tests_properties(roundtrip_rs PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;49;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test(roundtrip_example "/root/repo/native/build/ceph_erasure_code_benchmark" "-p" "example" "-w" "decode" "-i" "2" "-s" "4096" "-P" "k=3" "-P" "m=1" "-e" "1" "-d" "/root/repo/native/build")
+set_tests_properties(roundtrip_example PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;52;add_test;/root/repo/native/CMakeLists.txt;0;")

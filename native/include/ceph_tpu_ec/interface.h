@@ -1,0 +1,95 @@
+// ErasureCodeInterface — the native contract every plugin implements.
+//
+// Mirrors src/erasure-code/ErasureCodeInterface.h -> class
+// ErasureCodeInterface (Luminous..Quincy signature family: std::set<int> /
+// std::map<int, buffer>, SURVEY.md §2.2), with std::string as the buffer
+// type (the bufferlist role: contiguous byte ownership).
+
+#pragma once
+
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ceph_tpu_ec {
+
+using ErasureCodeProfile = std::map<std::string, std::string>;
+using ChunkMap = std::map<int, std::string>;
+
+class ErasureCodeInterface {
+ public:
+  virtual ~ErasureCodeInterface() = default;
+
+  // init(profile, ss): 0 on success, -EINVAL with message in *ss.
+  virtual int init(const ErasureCodeProfile &profile, std::string *ss) = 0;
+
+  virtual const ErasureCodeProfile &get_profile() const = 0;
+  virtual unsigned int get_chunk_count() const = 0;        // k + m
+  virtual unsigned int get_data_chunk_count() const = 0;   // k
+  virtual unsigned int get_coding_chunk_count() const {
+    return get_chunk_count() - get_data_chunk_count();
+  }
+  virtual int get_sub_chunk_count() const { return 1; }
+  virtual unsigned int get_chunk_size(unsigned int stripe_width) const = 0;
+
+  // minimum: chunk id -> (offset, length) runs in sub-chunk units
+  virtual int minimum_to_decode(
+      const std::set<int> &want_to_read, const std::set<int> &available,
+      std::map<int, std::vector<std::pair<int, int>>> *minimum) = 0;
+
+  virtual int encode(const std::set<int> &want_to_encode,
+                     const std::string &in, ChunkMap *encoded) = 0;
+  virtual int encode_chunks(const std::set<int> &want_to_encode,
+                            ChunkMap *encoded) = 0;
+
+  virtual int decode(const std::set<int> &want_to_read,
+                     const ChunkMap &chunks, ChunkMap *decoded,
+                     int chunk_size) = 0;
+  virtual int decode_chunks(const std::set<int> &want_to_read,
+                            const ChunkMap &chunks, ChunkMap *decoded) = 0;
+
+  virtual std::vector<int> get_chunk_mapping() const { return {}; }
+};
+
+using ErasureCodeInterfaceRef = std::shared_ptr<ErasureCodeInterface>;
+
+// Base class with the shared behaviors (src/erasure-code/ErasureCode.{h,cc}
+// -> class ErasureCode): padding/alignment, default minimum_to_decode
+// (first k available), default decode via zero-fill + decode_chunks.
+class ErasureCode : public ErasureCodeInterface {
+ public:
+  static constexpr unsigned SIMD_ALIGN = 64;
+
+  int init(const ErasureCodeProfile &profile, std::string *ss) override;
+  const ErasureCodeProfile &get_profile() const override { return profile_; }
+  unsigned int get_chunk_count() const override { return k_ + m_; }
+  unsigned int get_data_chunk_count() const override { return k_; }
+  unsigned int get_chunk_size(unsigned int stripe_width) const override;
+
+  int minimum_to_decode(
+      const std::set<int> &want_to_read, const std::set<int> &available,
+      std::map<int, std::vector<std::pair<int, int>>> *minimum) override;
+
+  int encode(const std::set<int> &want_to_encode, const std::string &in,
+             ChunkMap *encoded) override;
+  int decode(const std::set<int> &want_to_read, const ChunkMap &chunks,
+             ChunkMap *decoded, int chunk_size) override;
+
+ protected:
+  // subclass hooks (parse profile, build tables)
+  virtual int parse(const ErasureCodeProfile &profile, std::string *ss) = 0;
+  virtual int prepare(std::string *ss) { (void)ss; return 0; }
+
+  static int to_int(const std::string &name,
+                    const ErasureCodeProfile &profile,
+                    const std::string &dflt, std::string *ss, int *out);
+
+  ErasureCodeProfile profile_;
+  unsigned k_ = 0;
+  unsigned m_ = 0;
+};
+
+}  // namespace ceph_tpu_ec

@@ -1,0 +1,66 @@
+// ErasureCodePluginRegistry — dlopen plugin loading.
+//
+// Mirrors src/erasure-code/ErasureCodePlugin.{h,cc}: the registry
+// singleton loads "libec_<name>.so" from a plugin directory, gates on the
+// __erasure_code_version data symbol, then calls __erasure_code_init
+// (which must registry.add() a plugin whose factory() yields configured
+// ErasureCodeInterface instances).  disable_dlclose keeps handles alive
+// for symbolizable leak reports (valgrind parity).
+
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "ceph_tpu_ec/interface.h"
+
+namespace ceph_tpu_ec {
+
+// version-gate string; mismatched plugins are refused at load time
+// (ErasureCodePlugin.h -> __erasure_code_version)
+extern const char ERASURE_CODE_VERSION[];
+
+class ErasureCodePlugin {
+ public:
+  virtual ~ErasureCodePlugin() = default;
+  virtual int factory(const std::string &directory,
+                      const ErasureCodeProfile &profile,
+                      ErasureCodeInterfaceRef *erasure_code,
+                      std::string *ss) = 0;
+  void *library = nullptr;  // dlopen handle (owned by the registry)
+};
+
+class ErasureCodePluginRegistry {
+ public:
+  static ErasureCodePluginRegistry &instance();
+
+  int add(const std::string &name, ErasureCodePlugin *plugin);
+  int remove(const std::string &name);
+  ErasureCodePlugin *get(const std::string &name);
+
+  // load + factory (ErasureCodePlugin.cc -> factory): resolves the
+  // plugin by name, loading libec_<name>.so from `directory` if needed.
+  int factory(const std::string &plugin_name, const std::string &directory,
+              const ErasureCodeProfile &profile,
+              ErasureCodeInterfaceRef *erasure_code, std::string *ss);
+
+  int load(const std::string &plugin_name, const std::string &directory,
+           ErasureCodePlugin **plugin, std::string *ss);
+
+  bool disable_dlclose = true;
+
+ private:
+  ErasureCodePluginRegistry() = default;
+  ~ErasureCodePluginRegistry();
+
+  std::mutex lock_;  // held across load (ErasureCodePlugin.cc plugins_lock)
+  bool loading_ = false;
+  std::map<std::string, ErasureCodePlugin *> plugins_;
+};
+
+}  // namespace ceph_tpu_ec
+
+// entry points every plugin .so must export (C linkage, dlsym'd):
+//   const char __erasure_code_version[];
+//   int __erasure_code_init(const char *plugin_name, const char *directory);

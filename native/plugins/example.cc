@@ -1,0 +1,89 @@
+// libec_example — minimal XOR plugin (k data + 1 parity).
+//
+// Role of src/test/erasure-code/ErasureCodeExample.h +
+// ErasureCodePluginExample.cc: the didactic minimal conforming plugin
+// and the dlopen test fixture.
+
+#include <cerrno>
+#include <cstring>
+
+#include "ceph_tpu_ec/plugin.h"
+
+namespace ceph_tpu_ec {
+
+class ErasureCodeExample : public ErasureCode {
+ public:
+  int parse(const ErasureCodeProfile &profile, std::string *ss) override {
+    int k = 0, m = 0;
+    int r = to_int("k", profile, "2", ss, &k);
+    if (!r) r = to_int("m", profile, "1", ss, &m);
+    if (r) return r;
+    if (m != 1) {
+      if (ss) *ss = "example plugin requires m=1 (XOR parity)";
+      return -EINVAL;
+    }
+    if (k < 2) {
+      if (ss) *ss = "k must be >= 2";
+      return -EINVAL;
+    }
+    k_ = k;
+    m_ = 1;
+    return 0;
+  }
+
+  int encode_chunks(const std::set<int> &want, ChunkMap *encoded) override {
+    (void)want;
+    size_t len = encoded->at(0).size();
+    uint8_t *p = (uint8_t *)encoded->at((int)k_).data();
+    std::memset(p, 0, len);
+    for (unsigned i = 0; i < k_; i++) {
+      const uint8_t *s = (const uint8_t *)encoded->at((int)i).data();
+      for (size_t b = 0; b < len; b++) p[b] ^= s[b];
+    }
+    return 0;
+  }
+
+  int decode_chunks(const std::set<int> &want, const ChunkMap &chunks,
+                    ChunkMap *decoded) override {
+    (void)want;
+    if (chunks.size() < k_) return -EIO;
+    size_t len = chunks.begin()->second.size();
+    int missing = -1;
+    for (unsigned i = 0; i <= k_; i++)
+      if (!chunks.count((int)i)) { missing = (int)i; break; }
+    if (missing < 0) return 0;
+    std::string &buf = (*decoded)[missing];
+    buf.assign(len, '\0');
+    uint8_t *p = (uint8_t *)buf.data();
+    for (auto &kv : chunks) {
+      const uint8_t *s = (const uint8_t *)kv.second.data();
+      for (size_t b = 0; b < len; b++) p[b] ^= s[b];
+    }
+    return 0;
+  }
+};
+
+class ErasureCodePluginExample : public ErasureCodePlugin {
+ public:
+  int factory(const std::string &directory, const ErasureCodeProfile &profile,
+              ErasureCodeInterfaceRef *erasure_code,
+              std::string *ss) override {
+    (void)directory;
+    auto ec = std::make_shared<ErasureCodeExample>();
+    int r = ec->init(profile, ss);
+    if (r) return r;
+    *erasure_code = ec;
+    return 0;
+  }
+};
+
+}  // namespace ceph_tpu_ec
+
+extern "C" const char __erasure_code_version[] = "ceph_tpu 0.1";
+
+extern "C" int __erasure_code_init(const char *plugin_name,
+                                   const char *directory) {
+  (void)directory;
+  return ceph_tpu_ec::ErasureCodePluginRegistry::instance().add(
+      plugin_name, new ceph_tpu_ec::ErasureCodePluginExample());
+}

@@ -1,0 +1,137 @@
+// libec_rs — native GF(2^8) Reed-Solomon plugin (reed_sol_van).
+//
+// The native-CPU twin of ceph_tpu/codes/plugins/jerasure.py's
+// reed_sol_van technique (role of src/erasure-code/jerasure/
+// ErasureCodeJerasure.cc + vendored jerasure): byte-identical parity via
+// the same Vandermonde systematization, AVX2 pshufb region kernels.
+// This is the measurable SIMD CPU baseline the TPU path is compared to.
+
+#include <cerrno>
+#include <cstring>
+
+#include "ceph_tpu_ec/plugin.h"
+#include "../src/gf8.h"
+
+namespace ceph_tpu_ec {
+
+class ErasureCodeRs : public ErasureCode {
+ public:
+  int parse(const ErasureCodeProfile &profile, std::string *ss) override {
+    int k = 0, m = 0, w = 0;
+    int r = to_int("k", profile, "4", ss, &k);
+    if (!r) r = to_int("m", profile, "2", ss, &m);
+    if (!r) r = to_int("w", profile, "8", ss, &w);
+    if (r) return r;
+    auto it = profile.find("technique");
+    if (it != profile.end() && it->second != "reed_sol_van") {
+      if (ss) *ss = "technique " + it->second + " not supported (reed_sol_van)";
+      return -EINVAL;
+    }
+    if (w != 8) {
+      if (ss) *ss = "w=" + std::to_string(w) + " must be 8";
+      return -EINVAL;
+    }
+    if (k < 2 || m < 1 || k + m > 255) {
+      if (ss) *ss = "require 2 <= k, 1 <= m, k+m <= 255";
+      return -EINVAL;
+    }
+    k_ = k;
+    m_ = m;
+    return 0;
+  }
+
+  int prepare(std::string *ss) override {
+    (void)ss;
+    matrix_ = gf8::reed_sol_vandermonde(k_, m_);
+    return 0;
+  }
+
+  int encode_chunks(const std::set<int> &want, ChunkMap *encoded) override {
+    (void)want;
+    size_t len = encoded->at(0).size();
+    std::vector<const uint8_t *> in(k_);
+    std::vector<uint8_t *> out(m_);
+    for (unsigned i = 0; i < k_; i++)
+      in[i] = (const uint8_t *)encoded->at((int)i).data();
+    for (unsigned i = 0; i < m_; i++)
+      out[i] = (uint8_t *)encoded->at((int)(k_ + i)).data();
+    gf8::matrix_apply(matrix_, in, len, out);
+    return 0;
+  }
+
+  int decode_chunks(const std::set<int> &want, const ChunkMap &chunks,
+                    ChunkMap *decoded) override {
+    // jerasure_matrix_decode semantics: invert the surviving k x k
+    // submatrix of [I_k ; M], recover data, re-encode erased parity
+    (void)want;
+    std::vector<int> survivors;
+    for (auto &kv : chunks)
+      if (survivors.size() < k_) survivors.push_back(kv.first);
+    if (survivors.size() < k_) return -EIO;
+    size_t len = chunks.begin()->second.size();
+    std::vector<std::vector<uint8_t>> sub(k_, std::vector<uint8_t>(k_, 0));
+    for (unsigned r = 0; r < k_; r++) {
+      int c = survivors[r];
+      if (c < (int)k_)
+        sub[r][c] = 1;
+      else
+        sub[r] = matrix_[c - k_];
+    }
+    if (!gf8::invert(&sub)) return -EIO;
+    // data rows needed (erased data) + erased parity rows
+    std::vector<const uint8_t *> in(k_);
+    for (unsigned r = 0; r < k_; r++)
+      in[r] = (const uint8_t *)chunks.at(survivors[r]).data();
+    std::vector<std::string> data(k_);
+    std::vector<const uint8_t *> data_ptr(k_);
+    for (unsigned i = 0; i < k_; i++) {
+      if (chunks.count((int)i)) {
+        data_ptr[i] = (const uint8_t *)chunks.at((int)i).data();
+      } else {
+        data[i].assign(len, '\0');
+        std::vector<uint8_t *> out = {(uint8_t *)data[i].data()};
+        gf8::matrix_apply({sub[i]}, in, len, out);
+        data_ptr[i] = (const uint8_t *)data[i].data();
+        (*decoded)[(int)i] = data[i];
+      }
+    }
+    for (unsigned j = 0; j < m_; j++) {
+      int c = (int)(k_ + j);
+      if (!chunks.count(c)) {
+        std::string &buf = (*decoded)[c];
+        buf.assign(len, '\0');
+        std::vector<uint8_t *> out = {(uint8_t *)buf.data()};
+        gf8::matrix_apply({matrix_[j]}, data_ptr, len, out);
+      }
+    }
+    return 0;
+  }
+
+ private:
+  std::vector<std::vector<uint8_t>> matrix_;
+};
+
+class ErasureCodePluginRs : public ErasureCodePlugin {
+ public:
+  int factory(const std::string &directory, const ErasureCodeProfile &profile,
+              ErasureCodeInterfaceRef *erasure_code,
+              std::string *ss) override {
+    (void)directory;
+    auto ec = std::make_shared<ErasureCodeRs>();
+    int r = ec->init(profile, ss);
+    if (r) return r;
+    *erasure_code = ec;
+    return 0;
+  }
+};
+
+}  // namespace ceph_tpu_ec
+
+extern "C" const char __erasure_code_version[] = "ceph_tpu 0.1";
+
+extern "C" int __erasure_code_init(const char *plugin_name,
+                                   const char *directory) {
+  (void)directory;
+  return ceph_tpu_ec::ErasureCodePluginRegistry::instance().add(
+      plugin_name, new ceph_tpu_ec::ErasureCodePluginRs());
+}

@@ -1,0 +1,317 @@
+// libec_tpu — the TPU bridge plugin (the north star's plugin=tpu).
+//
+// Implements the native ErasureCodeInterface by forwarding chunk batches
+// to the Python/JAX runtime (ceph_tpu.codes) through an embedded CPython
+// interpreter: an unmodified native consumer (the benchmark binary here;
+// ECBackend's role upstream) selects `plugin=tpu` via the dlopen registry
+// and every encode_chunks/decode lands on the batched XLA/Pallas paths.
+// SURVEY.md §7 step 8 (PJRT-C-API vs resident-worker decision: embedded
+// CPython — one process, zero IPC, the GIL is irrelevant because the
+// consumer's data path is already serialized per instance).
+//
+// Profile keys: backend=<python plugin name> (default jerasure); every
+// other key is forwarded verbatim to the Python plugin's profile.
+// Environment:
+//   CEPH_TPU_PYROOT      — repo root to prepend to sys.path
+//                          (default: compile-time CEPH_TPU_PYROOT_DEFAULT)
+//   CEPH_TPU_JAX_PLATFORM — force a jax platform (e.g. "cpu") before
+//                          first use; useful when no TPU is attached.
+
+#include <Python.h>
+
+#include <cerrno>
+#include <cstdlib>
+#include <string>
+
+#include "ceph_tpu_ec/plugin.h"
+
+namespace ceph_tpu_ec {
+
+namespace {
+
+// one interpreter per process; never finalized (the registry keeps the
+// plugin .so resident — disable_dlclose — so this is process-lifetime)
+int ensure_python(std::string *ss) {
+  if (Py_IsInitialized()) return 0;
+  Py_InitializeEx(0);
+  const char *root = std::getenv("CEPH_TPU_PYROOT");
+#ifdef CEPH_TPU_PYROOT_DEFAULT
+  if (!root) root = CEPH_TPU_PYROOT_DEFAULT;
+#endif
+  std::string code = "import sys\n";
+  if (root) code += "sys.path.insert(0, '" + std::string(root) + "')\n";
+  const char *plat = std::getenv("CEPH_TPU_JAX_PLATFORM");
+  if (plat) {
+    code += "import os\nos.environ['JAX_PLATFORMS'] = '" +
+            std::string(plat) + "'\n";
+    code += "import jax\njax.config.update('jax_platforms', '" +
+            std::string(plat) + "')\n";
+  }
+  if (PyRun_SimpleString(code.c_str()) != 0) {
+    if (ss) *ss = "bridge: python path setup failed";
+    return -EIO;
+  }
+  return 0;
+}
+
+// fetch the python exception as a string (never throw across the ABI)
+std::string py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+}  // namespace
+
+class TpuErasureCode : public ErasureCode {
+ public:
+  ~TpuErasureCode() override {
+    if (ec_) {
+      PyGILState_STATE g = PyGILState_Ensure();
+      Py_DECREF(ec_);
+      PyGILState_Release(g);
+    }
+  }
+
+  int parse(const ErasureCodeProfile &, std::string *) override { return 0; }
+
+  int init(const ErasureCodeProfile &profile, std::string *ss) override {
+    int r = ensure_python(ss);
+    if (r) return r;
+    PyGILState_STATE g = PyGILState_Ensure();
+    r = init_locked(profile, ss);
+    PyGILState_Release(g);
+    return r;
+  }
+
+  unsigned int get_chunk_size(unsigned int stripe_width) const override {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *res = PyObject_CallMethod(ec_, "get_chunk_size", "I",
+                                        stripe_width);
+    unsigned v = 0;
+    if (res) {
+      v = (unsigned)PyLong_AsUnsignedLong(res);
+      Py_DECREF(res);
+    } else {
+      PyErr_Clear();
+    }
+    PyGILState_Release(g);
+    return v;
+  }
+
+  int get_sub_chunk_count() const override { return sub_chunk_count_; }
+
+  int encode_chunks(const std::set<int> &want, ChunkMap *encoded) override {
+    (void)want;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *chunks = PyDict_New();
+    for (unsigned i = 0; i < k_; i++) {
+      const std::string &buf = encoded->at((int)i);
+      PyObject *b = PyBytes_FromStringAndSize(buf.data(), buf.size());
+      PyObject *key = PyLong_FromLong((long)i);
+      PyDict_SetItem(chunks, key, b);
+      Py_DECREF(key);
+      Py_DECREF(b);
+    }
+    PyObject *wantset = PySet_New(nullptr);
+    for (unsigned i = 0; i < k_ + m_; i++) {
+      PyObject *key = PyLong_FromLong((long)i);
+      PySet_Add(wantset, key);
+      Py_DECREF(key);
+    }
+    PyObject *res =
+        PyObject_CallMethod(ec_, "encode_chunks", "OO", wantset, chunks);
+    Py_DECREF(wantset);
+    Py_DECREF(chunks);
+    int r = copy_out(res, encoded);
+    PyGILState_Release(g);
+    return r;
+  }
+
+  int decode_chunks(const std::set<int> &want, const ChunkMap &chunks,
+                    ChunkMap *decoded) override {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *avail = PyDict_New();
+    Py_ssize_t chunk_size = 0;
+    for (auto &kv : chunks) {
+      chunk_size = (Py_ssize_t)kv.second.size();
+      PyObject *b =
+          PyBytes_FromStringAndSize(kv.second.data(), kv.second.size());
+      PyObject *key = PyLong_FromLong(kv.first);
+      PyDict_SetItem(avail, key, b);
+      Py_DECREF(key);
+      Py_DECREF(b);
+    }
+    PyObject *wantset = PySet_New(nullptr);
+    for (int c : want) {
+      PyObject *key = PyLong_FromLong(c);
+      PySet_Add(wantset, key);
+      Py_DECREF(key);
+    }
+    PyObject *res = PyObject_CallMethod(ec_, "decode", "OOn", wantset,
+                                        avail, chunk_size);
+    Py_DECREF(wantset);
+    Py_DECREF(avail);
+    int r = copy_out(res, decoded);
+    PyGILState_Release(g);
+    return r;
+  }
+
+  int minimum_to_decode(
+      const std::set<int> &want_to_read, const std::set<int> &available,
+      std::map<int, std::vector<std::pair<int, int>>> *minimum) override {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *w = PySet_New(nullptr);
+    for (int c : want_to_read) {
+      PyObject *k = PyLong_FromLong(c);
+      PySet_Add(w, k);
+      Py_DECREF(k);
+    }
+    PyObject *a = PySet_New(nullptr);
+    for (int c : available) {
+      PyObject *k = PyLong_FromLong(c);
+      PySet_Add(a, k);
+      Py_DECREF(k);
+    }
+    PyObject *res =
+        PyObject_CallMethod(ec_, "minimum_to_decode", "OO", w, a);
+    Py_DECREF(w);
+    Py_DECREF(a);
+    int r = 0;
+    if (!res) {
+      PyErr_Clear();
+      r = -EIO;
+    } else {
+      PyObject *key = nullptr, *val = nullptr;
+      Py_ssize_t pos = 0;
+      while (PyDict_Next(res, &pos, &key, &val)) {
+        auto &runs = (*minimum)[(int)PyLong_AsLong(key)];
+        PyObject *it = PyObject_GetIter(val);
+        PyObject *pair;
+        while (it && (pair = PyIter_Next(it))) {
+          long off = PyLong_AsLong(PyTuple_GetItem(pair, 0));
+          long len = PyLong_AsLong(PyTuple_GetItem(pair, 1));
+          runs.emplace_back((int)off, (int)len);
+          Py_DECREF(pair);
+        }
+        Py_XDECREF(it);
+      }
+      Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+    return r;
+  }
+
+ private:
+  int init_locked(const ErasureCodeProfile &profile, std::string *ss) {
+    PyObject *mod = PyImport_ImportModule("ceph_tpu.codes.registry");
+    if (!mod) {
+      if (ss) *ss = "bridge: import ceph_tpu failed: " + py_error();
+      return -EIO;
+    }
+    PyObject *cls =
+        PyObject_GetAttrString(mod, "ErasureCodePluginRegistry");
+    Py_DECREF(mod);
+    PyObject *registry =
+        cls ? PyObject_CallMethod(cls, "instance", nullptr) : nullptr;
+    Py_XDECREF(cls);
+    if (!registry) {
+      if (ss) *ss = "bridge: registry unavailable: " + py_error();
+      return -EIO;
+    }
+    std::string backend = "jerasure";
+    PyObject *prof = PyDict_New();
+    for (auto &kv : profile) {
+      if (kv.first == "backend") {
+        backend = kv.second;
+        continue;
+      }
+      if (kv.first == "plugin" || kv.first == "directory") continue;
+      PyObject *v = PyUnicode_FromString(kv.second.c_str());
+      PyDict_SetItemString(prof, kv.first.c_str(), v);
+      Py_DECREF(v);
+    }
+    ec_ = PyObject_CallMethod(registry, "factory", "sO", backend.c_str(),
+                              prof);
+    Py_DECREF(prof);
+    Py_DECREF(registry);
+    if (!ec_) {
+      if (ss) *ss = "bridge: factory(" + backend + ") failed: " + py_error();
+      return -EINVAL;
+    }
+    profile_ = profile;
+    PyObject *kk = PyObject_CallMethod(ec_, "get_data_chunk_count", nullptr);
+    PyObject *nn = PyObject_CallMethod(ec_, "get_chunk_count", nullptr);
+    PyObject *sc = PyObject_CallMethod(ec_, "get_sub_chunk_count", nullptr);
+    if (!kk || !nn || !sc) {
+      if (ss) *ss = "bridge: counts failed: " + py_error();
+      Py_XDECREF(kk);
+      Py_XDECREF(nn);
+      Py_XDECREF(sc);
+      return -EIO;
+    }
+    k_ = (unsigned)PyLong_AsLong(kk);
+    m_ = (unsigned)PyLong_AsLong(nn) - k_;
+    sub_chunk_count_ = (int)PyLong_AsLong(sc);
+    Py_DECREF(kk);
+    Py_DECREF(nn);
+    Py_DECREF(sc);
+    return 0;
+  }
+
+  int copy_out(PyObject *res, ChunkMap *out) {
+    if (!res) {
+      PyErr_Clear();
+      return -EIO;
+    }
+    PyObject *key = nullptr, *val = nullptr;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(res, &pos, &key, &val)) {
+      char *data = nullptr;
+      Py_ssize_t len = 0;
+      if (PyBytes_AsStringAndSize(val, &data, &len) == 0)
+        (*out)[(int)PyLong_AsLong(key)] = std::string(data, (size_t)len);
+    }
+    Py_DECREF(res);
+    return 0;
+  }
+
+  PyObject *ec_ = nullptr;
+  int sub_chunk_count_ = 1;
+};
+
+class ErasureCodePluginTpu : public ErasureCodePlugin {
+ public:
+  int factory(const std::string &directory, const ErasureCodeProfile &profile,
+              ErasureCodeInterfaceRef *erasure_code,
+              std::string *ss) override {
+    (void)directory;
+    auto ec = std::make_shared<TpuErasureCode>();
+    int r = ec->init(profile, ss);
+    if (r) return r;
+    *erasure_code = ec;
+    return 0;
+  }
+};
+
+}  // namespace ceph_tpu_ec
+
+extern "C" const char __erasure_code_version[] = "ceph_tpu 0.1";
+
+extern "C" int __erasure_code_init(const char *plugin_name,
+                                   const char *directory) {
+  (void)directory;
+  return ceph_tpu_ec::ErasureCodePluginRegistry::instance().add(
+      plugin_name, new ceph_tpu_ec::ErasureCodePluginTpu());
+}

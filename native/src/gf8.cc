@@ -1,0 +1,188 @@
+#include "gf8.h"
+
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+namespace ceph_tpu_ec {
+namespace gf8 {
+
+namespace {
+
+struct Tables {
+  uint8_t mul[256][256];
+  uint8_t inv[256];
+  // 4-bit split tables: lo[c][n] = c * n, hi[c][n] = c * (n << 4)
+  alignas(32) uint8_t lo[256][16];
+  alignas(32) uint8_t hi[256][16];
+
+  Tables() {
+    for (int a = 0; a < 256; a++) {
+      for (int b = 0; b < 256; b++) {
+        // carryless multiply + reduce by POLY
+        int p = 0;
+        int aa = a;
+        int bb = b;
+        while (bb) {
+          if (bb & 1) p ^= aa;
+          bb >>= 1;
+          aa <<= 1;
+          if (aa & 0x100) aa ^= POLY;
+        }
+        mul[a][b] = (uint8_t)p;
+      }
+    }
+    for (int a = 1; a < 256; a++)
+      for (int b = 1; b < 256; b++)
+        if (mul[a][b] == 1) inv[a] = (uint8_t)b;
+    for (int c = 0; c < 256; c++) {
+      for (int n = 0; n < 16; n++) {
+        lo[c][n] = mul[c][n];
+        hi[c][n] = mul[c][n << 4];
+      }
+    }
+  }
+};
+
+const Tables &tables() {
+  static Tables t;
+  return t;
+}
+
+}  // namespace
+
+uint8_t mul(uint8_t a, uint8_t b) { return tables().mul[a][b]; }
+uint8_t inv(uint8_t a) { return tables().inv[a]; }
+uint8_t div(uint8_t a, uint8_t b) { return tables().mul[a][tables().inv[b]]; }
+
+void mul_region_xor(uint8_t c, const uint8_t *src, uint8_t *dst,
+                    size_t len) {
+  if (c == 0) return;
+  size_t i = 0;
+  if (c == 1) {
+#if defined(__AVX2__)
+    for (; i + 32 <= len; i += 32) {
+      __m256i s = _mm256_loadu_si256((const __m256i *)(src + i));
+      __m256i d = _mm256_loadu_si256((__m256i *)(dst + i));
+      _mm256_storeu_si256((__m256i *)(dst + i), _mm256_xor_si256(d, s));
+    }
+#endif
+    for (; i < len; i++) dst[i] ^= src[i];
+    return;
+  }
+  const Tables &t = tables();
+#if defined(__AVX2__)
+  // gf-complete's 4-bit split pshufb kernel (gf_w8_split_multiply_region)
+  __m256i lo = _mm256_broadcastsi128_si256(
+      _mm_load_si128((const __m128i *)t.lo[c]));
+  __m256i hi = _mm256_broadcastsi128_si256(
+      _mm_load_si128((const __m128i *)t.hi[c]));
+  __m256i mask = _mm256_set1_epi8(0x0F);
+  for (; i + 32 <= len; i += 32) {
+    __m256i s = _mm256_loadu_si256((const __m256i *)(src + i));
+    __m256i l = _mm256_shuffle_epi8(lo, _mm256_and_si256(s, mask));
+    __m256i h = _mm256_shuffle_epi8(
+        hi, _mm256_and_si256(_mm256_srli_epi64(s, 4), mask));
+    __m256i p = _mm256_xor_si256(l, h);
+    __m256i d = _mm256_loadu_si256((__m256i *)(dst + i));
+    _mm256_storeu_si256((__m256i *)(dst + i), _mm256_xor_si256(d, p));
+  }
+#endif
+  const uint8_t *row = t.mul[c];
+  for (; i < len; i++) dst[i] ^= row[src[i]];
+}
+
+void mul_region(uint8_t c, const uint8_t *src, uint8_t *dst, size_t len) {
+  std::memset(dst, 0, len);
+  mul_region_xor(c, src, dst, len);
+}
+
+void matrix_apply(const std::vector<std::vector<uint8_t>> &matrix,
+                  const std::vector<const uint8_t *> &in, size_t len,
+                  const std::vector<uint8_t *> &out) {
+  for (size_t r = 0; r < matrix.size(); r++) {
+    std::memset(out[r], 0, len);
+    for (size_t j = 0; j < in.size(); j++)
+      mul_region_xor(matrix[r][j], in[j], out[r], len);
+  }
+}
+
+bool invert(std::vector<std::vector<uint8_t>> *mat) {
+  size_t n = mat->size();
+  std::vector<std::vector<uint8_t>> a(*mat);
+  std::vector<std::vector<uint8_t>> b(n, std::vector<uint8_t>(n, 0));
+  for (size_t i = 0; i < n; i++) b[i][i] = 1;
+  for (size_t col = 0; col < n; col++) {
+    size_t piv = col;
+    while (piv < n && a[piv][col] == 0) piv++;
+    if (piv == n) return false;
+    std::swap(a[piv], a[col]);
+    std::swap(b[piv], b[col]);
+    uint8_t s = inv(a[col][col]);
+    for (size_t j = 0; j < n; j++) {
+      a[col][j] = mul(s, a[col][j]);
+      b[col][j] = mul(s, b[col][j]);
+    }
+    for (size_t r = 0; r < n; r++) {
+      if (r == col || a[r][col] == 0) continue;
+      uint8_t f = a[r][col];
+      for (size_t j = 0; j < n; j++) {
+        a[r][j] ^= mul(f, a[col][j]);
+        b[r][j] ^= mul(f, b[col][j]);
+      }
+    }
+  }
+  *mat = b;
+  return true;
+}
+
+std::vector<std::vector<uint8_t>> reed_sol_vandermonde(int k, int m) {
+  // reed_sol.c -> reed_sol_extended_vandermonde_matrix
+  int rows = k + m;
+  int cols = k;
+  std::vector<std::vector<uint8_t>> d(rows, std::vector<uint8_t>(cols, 0));
+  d[0][0] = 1;
+  d[rows - 1][cols - 1] = 1;
+  for (int i = 1; i < rows - 1; i++) {
+    uint8_t acc = 1;
+    for (int j = 0; j < cols; j++) {
+      d[i][j] = acc;
+      acc = mul(acc, (uint8_t)i);
+    }
+  }
+  // reed_sol.c -> reed_sol_big_vandermonde_distribution_matrix
+  for (int i = 1; i < cols; i++) {
+    int j = i;
+    while (j < rows && d[j][i] == 0) j++;
+    if (j != i) std::swap(d[i], d[j]);
+    if (d[i][i] != 1) {
+      uint8_t s = inv(d[i][i]);
+      for (int r = 0; r < rows; r++) d[r][i] = mul(s, d[r][i]);
+    }
+    for (int j2 = 0; j2 < cols; j2++) {
+      uint8_t e = d[i][j2];
+      if (j2 != i && e != 0)
+        for (int r = 0; r < rows; r++) d[r][j2] ^= mul(e, d[r][i]);
+    }
+  }
+  for (int j = 0; j < cols; j++) {
+    uint8_t e = d[cols][j];
+    if (e != 1) {
+      uint8_t s = inv(e);
+      for (int r = cols; r < rows; r++) d[r][j] = mul(s, d[r][j]);
+    }
+  }
+  for (int i = cols + 1; i < rows; i++) {
+    uint8_t e = d[i][0];
+    if (e != 1) {
+      uint8_t s = inv(e);
+      for (int j = 0; j < cols; j++) d[i][j] = mul(d[i][j], s);
+    }
+  }
+  return std::vector<std::vector<uint8_t>>(d.begin() + k, d.end());
+}
+
+}  // namespace gf8
+}  // namespace ceph_tpu_ec

@@ -1,0 +1,44 @@
+// GF(2^8) arithmetic, poly 0x11D — the native twin of ceph_tpu/gf/gf8.py
+// (the role of jerasure's galois.c + gf-complete's gf_w8.c, rebuilt).
+//
+// Region multiply uses the classic 4-bit split-table pshufb kernel when
+// AVX2 is available (gf-complete: gf_w8_split_multiply_region_sse family),
+// else a 64Ki product-table scalar loop.
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace ceph_tpu_ec {
+namespace gf8 {
+
+constexpr int POLY = 0x11D;
+
+// scalar field ops (table-backed after init)
+uint8_t mul(uint8_t a, uint8_t b);
+uint8_t div(uint8_t a, uint8_t b);
+uint8_t inv(uint8_t a);
+
+// dst ^= c * src over len bytes (region op; the inner hot loop)
+void mul_region_xor(uint8_t c, const uint8_t *src, uint8_t *dst,
+                    size_t len);
+// dst = c * src
+void mul_region(uint8_t c, const uint8_t *src, uint8_t *dst, size_t len);
+
+// (rows x k) * (k chunks of len bytes) -> rows parity chunks
+void matrix_apply(const std::vector<std::vector<uint8_t>> &matrix,
+                  const std::vector<const uint8_t *> &in, size_t len,
+                  const std::vector<uint8_t *> &out);
+
+// invert a square GF(2^8) matrix; false if singular
+bool invert(std::vector<std::vector<uint8_t>> *mat);
+
+// jerasure reed_sol.c -> reed_sol_vandermonde_coding_matrix (w=8):
+// extended Vandermonde brought to systematic form — byte-identical to
+// ceph_tpu/matrices/jerasure.py so native and Python parity agree.
+std::vector<std::vector<uint8_t>> reed_sol_vandermonde(int k, int m);
+
+}  // namespace gf8
+}  // namespace ceph_tpu_ec

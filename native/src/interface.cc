@@ -1,0 +1,109 @@
+// ErasureCode base behaviors (src/erasure-code/ErasureCode.cc).
+
+#include "ceph_tpu_ec/interface.h"
+
+#include <cerrno>
+#include <cstring>
+
+namespace ceph_tpu_ec {
+
+int ErasureCode::init(const ErasureCodeProfile &profile, std::string *ss) {
+  int r = parse(profile, ss);
+  if (r) return r;
+  profile_ = profile;
+  return prepare(ss);
+}
+
+unsigned int ErasureCode::get_chunk_size(unsigned int stripe_width) const {
+  // ErasureCode.cc -> get_chunk_size: pad so each of the k chunks is
+  // SIMD_ALIGN-aligned
+  unsigned chunk = (stripe_width + k_ - 1) / k_;
+  return (chunk + SIMD_ALIGN - 1) / SIMD_ALIGN * SIMD_ALIGN;
+}
+
+int ErasureCode::to_int(const std::string &name,
+                        const ErasureCodeProfile &profile,
+                        const std::string &dflt, std::string *ss, int *out) {
+  auto it = profile.find(name);
+  std::string v = (it == profile.end() || it->second.empty()) ? dflt
+                                                              : it->second;
+  try {
+    *out = std::stoi(v);
+  } catch (...) {
+    if (ss) *ss = "could not convert " + name + "=" + v + " to int";
+    return -EINVAL;
+  }
+  return 0;
+}
+
+int ErasureCode::minimum_to_decode(
+    const std::set<int> &want_to_read, const std::set<int> &available,
+    std::map<int, std::vector<std::pair<int, int>>> *minimum) {
+  // ErasureCode.cc -> _minimum_to_decode: want if all available, else
+  // the first k available in index order
+  minimum->clear();
+  bool all = true;
+  for (int c : want_to_read)
+    if (!available.count(c)) { all = false; break; }
+  if (all) {
+    for (int c : want_to_read) (*minimum)[c] = {{0, get_sub_chunk_count()}};
+    return 0;
+  }
+  if (available.size() < get_data_chunk_count()) return -EIO;
+  unsigned n = 0;
+  for (int c : available) {
+    if (n == get_data_chunk_count()) break;
+    (*minimum)[c] = {{0, get_sub_chunk_count()}};
+    ++n;
+  }
+  return 0;
+}
+
+int ErasureCode::encode(const std::set<int> &want_to_encode,
+                        const std::string &in, ChunkMap *encoded) {
+  // ErasureCode.cc -> encode/encode_prepare: pad to k * chunk_size,
+  // carve k data chunks, then encode_chunks
+  unsigned k = get_data_chunk_count();
+  unsigned n = get_chunk_count();
+  unsigned chunk_size = get_chunk_size(in.size());
+  std::string padded = in;
+  padded.resize((size_t)k * chunk_size, '\0');
+  for (unsigned i = 0; i < k; i++)
+    (*encoded)[(int)i] = padded.substr((size_t)i * chunk_size, chunk_size);
+  for (unsigned i = k; i < n; i++)
+    (*encoded)[(int)i] = std::string(chunk_size, '\0');
+  std::set<int> all;
+  for (unsigned i = 0; i < n; i++) all.insert((int)i);
+  int r = encode_chunks(all, encoded);
+  if (r) return r;
+  for (auto it = encoded->begin(); it != encoded->end();)
+    it = want_to_encode.count(it->first) ? std::next(it)
+                                         : encoded->erase(it);
+  return 0;
+}
+
+int ErasureCode::decode(const std::set<int> &want_to_read,
+                        const ChunkMap &chunks, ChunkMap *decoded,
+                        int chunk_size) {
+  // ErasureCode.cc -> _decode: pass-through if available, else
+  // zero-fill missing buffers and delegate to decode_chunks
+  bool all = true;
+  for (int c : want_to_read)
+    if (!chunks.count(c)) { all = false; break; }
+  if (all) {
+    for (int c : want_to_read) (*decoded)[c] = chunks.at(c);
+    return 0;
+  }
+  ChunkMap work(chunks);
+  for (unsigned i = 0; i < get_chunk_count(); i++)
+    if (!work.count((int)i))
+      (*decoded)[(int)i] = std::string(chunk_size, '\0');
+  int r = decode_chunks(want_to_read, chunks, decoded);
+  if (r) return r;
+  for (auto it = decoded->begin(); it != decoded->end();)
+    it = want_to_read.count(it->first) ? std::next(it)
+                                       : decoded->erase(it);
+  return 0;
+}
+
+}  // namespace ceph_tpu_ec

@@ -1,0 +1,133 @@
+// Plugin registry + dlopen loader (src/erasure-code/ErasureCodePlugin.cc).
+
+#include "ceph_tpu_ec/plugin.h"
+
+#include <dlfcn.h>
+
+#include <cerrno>
+#include <cstring>
+
+namespace ceph_tpu_ec {
+
+const char ERASURE_CODE_VERSION[] = "ceph_tpu 0.1";
+
+ErasureCodePluginRegistry &ErasureCodePluginRegistry::instance() {
+  static ErasureCodePluginRegistry singleton;
+  return singleton;
+}
+
+ErasureCodePluginRegistry::~ErasureCodePluginRegistry() {
+  for (auto &kv : plugins_) {
+    void *library = kv.second->library;
+    delete kv.second;
+    if (library && !disable_dlclose) dlclose(library);
+  }
+}
+
+int ErasureCodePluginRegistry::add(const std::string &name,
+                                   ErasureCodePlugin *plugin) {
+  // called from __erasure_code_init while load() holds the lock
+  // (ErasureCodePlugin.cc: loading flag instead of recursive lock)
+  if (!loading_) lock_.lock();
+  int r = 0;
+  if (plugins_.count(name)) {
+    r = -EEXIST;
+  } else {
+    plugins_[name] = plugin;
+  }
+  if (!loading_) lock_.unlock();
+  return r;
+}
+
+int ErasureCodePluginRegistry::remove(const std::string &name) {
+  std::lock_guard<std::mutex> g(lock_);
+  auto it = plugins_.find(name);
+  if (it == plugins_.end()) return -ENOENT;
+  delete it->second;
+  plugins_.erase(it);
+  return 0;
+}
+
+ErasureCodePlugin *ErasureCodePluginRegistry::get(const std::string &name) {
+  std::lock_guard<std::mutex> g(lock_);
+  auto it = plugins_.find(name);
+  return it == plugins_.end() ? nullptr : it->second;
+}
+
+int ErasureCodePluginRegistry::factory(const std::string &plugin_name,
+                                       const std::string &directory,
+                                       const ErasureCodeProfile &profile,
+                                       ErasureCodeInterfaceRef *erasure_code,
+                                       std::string *ss) {
+  ErasureCodePlugin *plugin = nullptr;
+  {
+    int r = load(plugin_name, directory, &plugin, ss);
+    if (r) return r;
+  }
+  return plugin->factory(directory, profile, erasure_code, ss);
+}
+
+int ErasureCodePluginRegistry::load(const std::string &plugin_name,
+                                    const std::string &directory,
+                                    ErasureCodePlugin **plugin,
+                                    std::string *ss) {
+  std::lock_guard<std::mutex> g(lock_);
+  auto it = plugins_.find(plugin_name);
+  if (it != plugins_.end()) {
+    *plugin = it->second;
+    return 0;
+  }
+  std::string fname = directory + "/libec_" + plugin_name + ".so";
+  void *library = dlopen(fname.c_str(), RTLD_NOW | RTLD_GLOBAL);
+  if (!library) {
+    if (ss) *ss = std::string("load dlopen(") + fname + "): " + dlerror();
+    return -EIO;
+  }
+  // version gate (ErasureCodePlugin.cc -> __erasure_code_version check)
+  const char *version =
+      (const char *)dlsym(library, "__erasure_code_version");
+  if (!version) {
+    if (ss)
+      *ss = "load dlsym(" + fname + ", __erasure_code_version): not found";
+    dlclose(library);
+    return -ENOENT;
+  }
+  if (std::strcmp(version, ERASURE_CODE_VERSION) != 0) {
+    if (ss)
+      *ss = "erasure_code_init(" + plugin_name + "): plugin version " +
+            version + " != expected " + ERASURE_CODE_VERSION;
+    dlclose(library);
+    return -ENOEXEC;
+  }
+  using init_fn = int (*)(const char *, const char *);
+  init_fn init = (init_fn)dlsym(library, "__erasure_code_init");
+  if (!init) {
+    if (ss)
+      *ss = "load dlsym(" + fname + ", __erasure_code_init): not found";
+    dlclose(library);
+    return -ENOENT;
+  }
+  loading_ = true;
+  int r = init(plugin_name.c_str(), directory.c_str());
+  loading_ = false;
+  if (r) {
+    if (ss)
+      *ss = "erasure_code_init(" + plugin_name + "," + directory +
+            "): " + std::strerror(-r);
+    dlclose(library);
+    return r;
+  }
+  auto it2 = plugins_.find(plugin_name);
+  if (it2 == plugins_.end()) {
+    if (ss)
+      *ss = "erasure_code_init(" + plugin_name +
+            ") did not register the plugin";
+    dlclose(library);
+    return -EBADF;
+  }
+  it2->second->library = library;
+  *plugin = it2->second;
+  return 0;
+}
+
+}  // namespace ceph_tpu_ec

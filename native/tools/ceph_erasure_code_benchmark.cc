@@ -1,0 +1,186 @@
+// ceph_erasure_code_benchmark — native benchmark binary.
+//
+// Mirrors src/test/erasure-code/ceph_erasure_code_benchmark.{h,cc} ->
+// class ErasureCodeBench: instantiates a plugin through the dlopen
+// registry (no daemon) and times encode/decode loops; prints
+// "<elapsed seconds>\t<total KiB>".
+//
+// Flags: --plugin/-p, --workload/-w encode|decode, --iterations/-i,
+// --size/-s, --parameter/-P k=v (repeated), --erasures/-e,
+// --erasures-generation/-E random|exhaustive, --erased (repeated),
+// --directory/-d (plugin dir), --verbose/-v.
+
+#include <getopt.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "ceph_tpu_ec/plugin.h"
+
+using namespace ceph_tpu_ec;
+
+namespace {
+
+struct Options {
+  std::string plugin = "rs";
+  std::string workload = "encode";
+  long iterations = 1;
+  long size = 1 << 20;
+  ErasureCodeProfile profile;
+  int erasures = 1;
+  std::string erasures_generation = "random";
+  std::vector<int> erased;
+  std::string directory = ".";
+  bool verbose = false;
+};
+
+int parse_args(int argc, char **argv, Options *o) {
+  static option longopts[] = {
+      {"plugin", required_argument, nullptr, 'p'},
+      {"workload", required_argument, nullptr, 'w'},
+      {"iterations", required_argument, nullptr, 'i'},
+      {"size", required_argument, nullptr, 's'},
+      {"parameter", required_argument, nullptr, 'P'},
+      {"erasures", required_argument, nullptr, 'e'},
+      {"erasures-generation", required_argument, nullptr, 'E'},
+      {"erased", required_argument, nullptr, 'x'},
+      {"directory", required_argument, nullptr, 'd'},
+      {"verbose", no_argument, nullptr, 'v'},
+      {nullptr, 0, nullptr, 0}};
+  int c;
+  while ((c = getopt_long(argc, argv, "p:w:i:s:P:e:E:d:v", longopts,
+                          nullptr)) != -1) {
+    switch (c) {
+      case 'p': o->plugin = optarg; break;
+      case 'w': o->workload = optarg; break;
+      case 'i': o->iterations = atol(optarg); break;
+      case 's': o->size = atol(optarg); break;
+      case 'P': {
+        std::string kv = optarg;
+        auto eq = kv.find('=');
+        if (eq == std::string::npos) {
+          std::cerr << "--parameter " << kv << " must be name=value\n";
+          return 1;
+        }
+        o->profile[kv.substr(0, eq)] = kv.substr(eq + 1);
+        break;
+      }
+      case 'e': o->erasures = atoi(optarg); break;
+      case 'E': o->erasures_generation = optarg; break;
+      case 'x': o->erased.push_back(atoi(optarg)); break;
+      case 'd': o->directory = optarg; break;
+      case 'v': o->verbose = true; break;
+      default: return 1;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  Options o;
+  if (parse_args(argc, argv, &o)) return 1;
+  if (const char *env = std::getenv("CEPH_TPU_EC_DIR"))
+    if (o.directory == ".") o.directory = env;
+
+  ErasureCodeInterfaceRef ec;
+  std::string ss;
+  int r = ErasureCodePluginRegistry::instance().factory(
+      o.plugin, o.directory, o.profile, &ec, &ss);
+  if (r) {
+    std::cerr << "plugin " << o.plugin << ": " << ss << "\n";
+    return 1;
+  }
+  unsigned k = ec->get_data_chunk_count();
+  unsigned n = ec->get_chunk_count();
+
+  std::mt19937_64 rng(42);
+  std::string in((size_t)o.size, '\0');
+  for (auto &ch : in) ch = (char)(rng() & 0xFF);
+
+  std::set<int> all;
+  for (unsigned i = 0; i < n; i++) all.insert((int)i);
+
+  using clock = std::chrono::steady_clock;
+  double elapsed = 0;
+  long total_bytes = 0;
+
+  if (o.workload == "encode") {
+    auto t0 = clock::now();
+    for (long it = 0; it < o.iterations; it++) {
+      ChunkMap encoded;
+      int rr = ec->encode(all, in, &encoded);
+      if (rr) {
+        std::cerr << "encode failed: " << rr << "\n";
+        return 1;
+      }
+      total_bytes += o.size;
+    }
+    elapsed = std::chrono::duration<double>(clock::now() - t0).count();
+  } else {
+    ChunkMap encoded;
+    if (ec->encode(all, in, &encoded)) return 1;
+    int chunk_size = (int)encoded.at(0).size();
+    // erasure pattern sequence (reference --erasures-generation)
+    std::vector<std::vector<int>> patterns;
+    if (!o.erased.empty()) {
+      patterns.assign(1, o.erased);
+    } else if (o.erasures_generation == "exhaustive") {
+      std::vector<int> idx(o.erasures);
+      std::vector<bool> sel(n, false);
+      std::fill(sel.begin(), sel.begin() + o.erasures, true);
+      do {
+        std::vector<int> pat;
+        for (unsigned i = 0; i < n; i++)
+          if (sel[i]) pat.push_back((int)i);
+        patterns.push_back(pat);
+      } while (std::prev_permutation(sel.begin(), sel.end()));
+    } else {
+      std::mt19937_64 erng(43);
+      for (long it = 0; it < o.iterations; it++) {
+        std::vector<int> ids(n);
+        for (unsigned i = 0; i < n; i++) ids[i] = (int)i;
+        std::shuffle(ids.begin(), ids.end(), erng);
+        ids.resize(o.erasures);
+        std::sort(ids.begin(), ids.end());
+        patterns.push_back(ids);
+      }
+    }
+    auto t0 = clock::now();
+    for (long it = 0; it < o.iterations; it++) {
+      const std::vector<int> &pat = patterns[it % patterns.size()];
+      ChunkMap avail(encoded);
+      std::set<int> want;
+      for (int c : pat) {
+        avail.erase(c);
+        want.insert(c);
+      }
+      ChunkMap decoded;
+      int rr = ec->decode(want, avail, &decoded, chunk_size);
+      if (rr) {
+        std::cerr << "decode failed: " << rr << "\n";
+        return 1;
+      }
+      for (int c : pat)
+        if (decoded.at(c) != encoded.at(c)) {
+          std::cerr << "decode mismatch chunk " << c << "\n";
+          return 1;
+        }
+      total_bytes += (long)k * chunk_size;
+    }
+    elapsed = std::chrono::duration<double>(clock::now() - t0).count();
+  }
+
+  printf("%.6f\t%ld\n", elapsed, total_bytes / 1024);
+  if (o.verbose)
+    fprintf(stderr, "%.3f GB/s\n", total_bytes / elapsed / 1e9);
+  return 0;
+}
