@@ -459,8 +459,12 @@ def crush_do_rule(cmap: CrushMap, ruleno: int, x: int, result_max: int,
                             CRUSH_RULE_CHOOSE_FIRSTN)
             recurse_to_leaf = op in (CRUSH_RULE_CHOOSELEAF_FIRSTN,
                                      CRUSH_RULE_CHOOSELEAF_INDEP)
-            o: List[int] = [0] * (result_max + 8)
-            c: List[int] = [0] * (result_max + 8)
+            # mapper.c hands each input bucket a fresh output segment
+            # (out = o+osize, outpos = j = 0, out_size = result_max-osize,
+            # out2 = c+osize): r-values restart at rep=0 per bucket and
+            # collision scans never cross segment boundaries.
+            o: List[int] = []
+            c: List[int] = []
             osize = 0
             for wi in w:
                 numrep = arg1
@@ -471,6 +475,9 @@ def crush_do_rule(cmap: CrushMap, ruleno: int, x: int, result_max: int,
                 if wi >= 0 or wi not in cmap.buckets:
                     continue  # probably CRUSH_ITEM_NONE
                 bucket = cmap.buckets[wi]
+                seg = result_max - osize
+                o_seg: List[int] = [0] * (seg + 8)
+                c_seg: List[int] = [0] * (seg + 8)
                 if firstn:
                     if choose_leaf_tries:
                         recurse_tries = choose_leaf_tries
@@ -478,23 +485,23 @@ def crush_do_rule(cmap: CrushMap, ruleno: int, x: int, result_max: int,
                         recurse_tries = 1
                     else:
                         recurse_tries = choose_tries
-                    osize = crush_choose_firstn(
+                    got = crush_choose_firstn(
                         cmap, work, bucket, weight, x, numrep, arg2,
-                        o, osize, result_max - osize, choose_tries,
+                        o_seg, 0, seg, choose_tries,
                         recurse_tries, choose_local_retries,
                         choose_local_fallback_retries, recurse_to_leaf,
-                        vary_r, stable, c, 0, choose_args)
+                        vary_r, stable, c_seg, 0, choose_args)
                 else:
-                    out_size = min(numrep, result_max - osize)
+                    got = min(numrep, seg)
                     crush_choose_indep(
-                        cmap, work, bucket, weight, x, out_size, numrep,
-                        arg2, o, osize, choose_tries,
+                        cmap, work, bucket, weight, x, got, numrep,
+                        arg2, o_seg, 0, choose_tries,
                         choose_leaf_tries if choose_leaf_tries else 1,
-                        recurse_to_leaf, c, 0, choose_args)
-                    osize += out_size
-            if recurse_to_leaf:
-                o[:osize] = c[:osize]
-            w = o[:osize]
+                        recurse_to_leaf, c_seg, 0, choose_args)
+                o.extend(o_seg[:got])
+                c.extend(c_seg[:got])
+                osize += got
+            w = c[:osize] if recurse_to_leaf else o[:osize]
             continue
         if op == CRUSH_RULE_EMIT:
             for item in w:

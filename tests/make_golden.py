@@ -1,0 +1,28 @@
+"""Regenerate committed golden files after an INTENTIONAL mapping
+change: ``python tests/make_golden.py``.  Review the diff — a golden
+change means stored placements move on real clusters.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from ceph_tpu.crush import crush_do_rule  # noqa: E402
+
+
+def main():
+    from test_crush_chained import _golden_maps, GOLDEN
+    golden = {}
+    for name, b in _golden_maps():
+        golden[name] = [crush_do_rule(b.map, 0, x, 2) for x in range(64)]
+    os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+    with open(GOLDEN, "w") as f:
+        json.dump(golden, f, indent=1, sort_keys=True)
+    print(f"wrote {GOLDEN}")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(__file__))
+    main()

@@ -1,0 +1,163 @@
+"""Chained choose steps — mapper.c -> crush_do_rule per-bucket segments.
+
+Upstream hands each input bucket of a choose step a FRESH output segment
+(out = o+osize, outpos = j = 0, out2 = c+osize): r-values restart at
+rep=0 per bucket and collision scans stay within the segment.  These
+tests pin the most common real EC rule shape (choose indep N type rack
+-> chooseleaf indep 1 type host) and the firstn variants, which the
+round-1/2 implementation evaluated with accumulated absolute outpos
+(r-shift + cross-segment collision scans + empty second segments under
+stable=0).
+"""
+
+import json
+import os
+
+import pytest
+
+from ceph_tpu.crush import (
+    CrushBuilder,
+    Tunables,
+    crush_do_rule,
+    step_choose_firstn,
+    step_choose_indep,
+    step_chooseleaf_firstn,
+    step_chooseleaf_indep,
+    step_emit,
+    step_take,
+)
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+
+RACK, HOST, ROOT = 2, 1, 3
+
+
+def build3(n_racks, hosts_per_rack, devs_per_host, tunables=None):
+    """root -> rack -> host -> osd, all straw2 (workspace-free)."""
+    b = CrushBuilder(tunables)
+    b.add_type(HOST, "host")
+    b.add_type(RACK, "rack")
+    b.add_type(ROOT, "root")
+    racks = []
+    d = 0
+    for _ in range(n_racks):
+        hosts = []
+        for _ in range(hosts_per_rack):
+            hosts.append(b.add_bucket(
+                "straw2", "host", list(range(d, d + devs_per_host))))
+            d += devs_per_host
+        racks.append(b.add_bucket("straw2", "rack", hosts))
+    root = b.add_bucket("straw2", "root", racks)
+    return b, root, racks
+
+
+def chain_rules(b, root, racks, indep):
+    """Rule 0: the chained EC shape.  Rule 1: first step only (which
+    racks).  Rules 10+i: the second step run directly on rack i —
+    with per-bucket segments this must reproduce rule 0 exactly."""
+    choose = step_choose_indep if indep else step_choose_firstn
+    leaf = step_chooseleaf_indep if indep else step_chooseleaf_firstn
+    b.add_rule(0, [step_take(root), choose(2, RACK), leaf(1, HOST),
+                   step_emit()])
+    b.add_rule(1, [step_take(root), choose(2, RACK), step_emit()])
+    for i, rk in enumerate(racks):
+        b.add_rule(10 + i, [step_take(rk), leaf(1, HOST), step_emit()])
+
+
+@pytest.mark.parametrize("indep", [True, False])
+def test_chained_choose_segments_are_independent(indep):
+    """result[i] of the chained rule == the direct per-rack rule: each
+    input bucket's choose call sees outpos=0 (mapper.c o+osize, j=0)."""
+    b, root, racks = build3(3, 3, 2)
+    chain_rules(b, root, racks, indep)
+    for x in range(300):
+        res = crush_do_rule(b.map, 0, x, 2)
+        picked = crush_do_rule(b.map, 1, x, 2)
+        assert len(res) == 2 and len(picked) == 2
+        for i, rk in enumerate(picked):
+            direct = crush_do_rule(b.map, 10 + racks.index(rk), x, 1)
+            assert res[i] == direct[0], (x, i, rk, res, direct)
+
+
+@pytest.mark.parametrize("indep", [True, False])
+def test_chained_choose_segments_stable0(indep):
+    """Same property under chooseleaf_stable=0 (pre-jewel): rep must
+    restart at 0 per segment, not at the accumulated osize.  Under the
+    old accumulated-outpos behavior the second firstn segment ran zero
+    reps (rep started == numrep) and emitted nothing at all."""
+    t = Tunables(chooseleaf_stable=0)
+    b, root, racks = build3(3, 3, 2, tunables=t)
+    chain_rules(b, root, racks, indep)
+    for x in range(200):
+        res = crush_do_rule(b.map, 0, x, 2)
+        picked = crush_do_rule(b.map, 1, x, 2)
+        assert len(res) == 2, (x, res)
+        for i, rk in enumerate(picked):
+            direct = crush_do_rule(b.map, 10 + racks.index(rk), x, 1)
+            assert res[i] == direct[0], (x, i, rk, res, direct)
+
+
+def test_chained_segments_no_cross_segment_collision_scan():
+    """A device reachable from two racks (dual-homed host) must NOT be
+    deduplicated across choose segments: mapper.c's firstn collision
+    scan covers out[0..outpos) of the CURRENT segment only."""
+    b = CrushBuilder()
+    b.add_type(HOST, "host")
+    b.add_type(RACK, "rack")
+    b.add_type(ROOT, "root")
+    shared = b.add_bucket("straw2", "host", [0])
+    r1 = b.add_bucket("straw2", "rack", [shared])
+    r2 = b.add_bucket("straw2", "rack", [shared])
+    root = b.add_bucket("straw2", "root", [r1, r2])
+    b.add_rule(0, [step_take(root), step_choose_firstn(2, RACK),
+                   step_chooseleaf_firstn(1, HOST), step_emit()])
+    for x in range(50):
+        res = crush_do_rule(b.map, 0, x, 2)
+        # both racks resolve to the same (only) device; a cross-segment
+        # collision scan would reject the second and emit one entry
+        assert res == [0, 0], (x, res)
+
+
+def test_multi_take_emit_blocks():
+    """take A ... emit; take B ... emit — result concatenates blocks and
+    each block evaluates exactly like its standalone rule."""
+    b, root, racks = build3(2, 2, 2)
+    b.add_rule(0, [step_take(racks[0]), step_chooseleaf_firstn(1, HOST),
+                   step_emit(),
+                   step_take(racks[1]), step_chooseleaf_firstn(1, HOST),
+                   step_emit()])
+    b.add_rule(1, [step_take(racks[0]), step_chooseleaf_firstn(1, HOST),
+                   step_emit()])
+    b.add_rule(2, [step_take(racks[1]), step_chooseleaf_firstn(1, HOST),
+                   step_emit()])
+    for x in range(100):
+        combined = crush_do_rule(b.map, 0, x, 4)
+        a = crush_do_rule(b.map, 1, x, 4)
+        c = crush_do_rule(b.map, 2, x, 4)
+        assert combined == a + c, (x, combined, a, c)
+
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "chained_rules.json")
+
+
+def _golden_maps():
+    out = []
+    for indep in (True, False):
+        for stable in (1, 0):
+            b, root, racks = build3(3, 3, 2,
+                                    Tunables(chooseleaf_stable=stable))
+            chain_rules(b, root, racks, indep)
+            out.append((f"indep={indep},stable={stable}", b))
+    return out
+
+
+def test_chained_rules_golden():
+    """Committed golden mappings for the chained shapes: any future
+    change to crush_do_rule segment semantics shows up as a golden
+    diff (regenerate with tests/make_golden.py after an intentional
+    change)."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    for name, b in _golden_maps():
+        got = [crush_do_rule(b.map, 0, x, 2) for x in range(64)]
+        assert golden[name] == got, name
