@@ -142,6 +142,22 @@ class ErasureCodeClay(ErasureCode):
         self._plane_decode_cache: Dict[tuple, np.ndarray] = {}
         self._linear_cache: Dict[tuple, np.ndarray] = {}
         self._powq = [self.q ** y for y in range(self.t)]
+        # ErasureCodeClay::get_chunk_size asks the scalar MDS sub-code for
+        # its 1-byte-stripe chunk size (its SIMD alignment analog); the
+        # reference instantiates the sub-plugin through the registry, so
+        # we do too (lazily, to keep plugin imports acyclic).
+        from ..registry import ErasureCodePluginRegistry
+        sub_profile = {"k": str(k + self.nu), "m": str(m), "w": str(W)}
+        if self.scalar_mds == "shec":
+            # shec's own "technique" means single/multiple recovery, not
+            # the MDS construction — don't forward clay's; give it the
+            # default durability overlap instead
+            sub_profile["c"] = str(min(2, m))
+        else:
+            sub_profile["technique"] = self.technique
+        sub = ErasureCodePluginRegistry.instance().factory(
+            self.scalar_mds, sub_profile)
+        self._scalar_align = sub.get_chunk_size(1)
 
     # -- counts / sizes -----------------------------------------------------
 
@@ -149,12 +165,13 @@ class ErasureCodeClay(ErasureCode):
         return self.sub_chunk_no
 
     def get_chunk_size(self, stripe_width: int) -> int:
-        """Chunk size padded so each chunk splits into sub_chunk_no equal
-        sub-chunks (ErasureCodeClay.cc -> get_chunk_size alignment)."""
-        k = self.k
-        chunk = (stripe_width + k - 1) // k
-        align = self.sub_chunk_no
-        return (chunk + align - 1) // align * align
+        """ErasureCodeClay.cc -> get_chunk_size: round the stripe up to
+        sub_chunk_no * k * <scalar-code 1-byte chunk size>, then divide
+        by k — every chunk splits into sub_chunk_no equal sub-chunks,
+        each aligned for the scalar MDS sub-code."""
+        alignment = self.sub_chunk_no * self.k * self._scalar_align
+        padded = (stripe_width + alignment - 1) // alignment * alignment
+        return padded // self.k
 
     # -- node / vertex geometry --------------------------------------------
 

@@ -21,6 +21,7 @@
 
 #include <cerrno>
 #include <cstdlib>
+#include <mutex>
 #include <string>
 
 #include "ceph_tpu_ec/plugin.h"
@@ -32,6 +33,8 @@ namespace {
 // one interpreter per process; never finalized (the registry keeps the
 // plugin .so resident — disable_dlclose — so this is process-lifetime)
 int ensure_python(std::string *ss) {
+  static std::mutex init_lock;
+  std::lock_guard<std::mutex> g(init_lock);
   if (Py_IsInitialized()) return 0;
   Py_InitializeEx(0);
   const char *root = std::getenv("CEPH_TPU_PYROOT");
@@ -47,7 +50,15 @@ int ensure_python(std::string *ss) {
     code += "import jax\njax.config.update('jax_platforms', '" +
             std::string(plat) + "')\n";
   }
-  if (PyRun_SimpleString(code.c_str()) != 0) {
+  int rc = PyRun_SimpleString(code.c_str());
+  // Py_InitializeEx leaves the calling thread holding the GIL; release
+  // it so every entry point (this thread's included) can take it via
+  // PyGILState_Ensure — the consumer's data path (ECBackend role) is
+  // multithreaded, and a held GIL would deadlock the second thread.
+  // The saved thread state is intentionally never restored: the
+  // interpreter lives for the process and all access is PyGILState_*.
+  PyEval_SaveThread();
+  if (rc != 0) {
     if (ss) *ss = "bridge: python path setup failed";
     return -EIO;
   }

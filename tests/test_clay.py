@@ -182,3 +182,50 @@ def test_sub_chunk_count_and_chunk_size():
         cs = ec.get_chunk_size(width)
         assert cs * 4 >= width
         assert cs % 8 == 0
+
+
+def test_get_chunk_size_reference_formula():
+    """ErasureCodeClay::get_chunk_size: round_up(stripe_width,
+    sub_chunk_no * k * scalar_align) / k, where scalar_align is the
+    scalar MDS sub-code's chunk size for a 1-byte stripe — pinned for
+    the BASELINE k=8 m=4 d=11 config (q=4, t=3, sub_chunk_no=64)."""
+    ec = make(8, 4, 11)
+    assert ec.get_sub_chunk_count() == 64
+    sub_mds = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"k": "8", "m": "4", "technique": "reed_sol_van",
+                     "w": "8"})
+    scalar_align = sub_mds.get_chunk_size(1)
+    alignment = 64 * 8 * scalar_align
+    for sw in (1, 4096, 1 << 20, alignment, alignment + 1):
+        want = -(-sw // alignment) * alignment // 8
+        got = ec.get_chunk_size(sw)
+        assert got == want, (sw, got, want)
+        assert got % 64 == 0  # chunk splits into equal sub-chunks
+        assert (got // 64) % scalar_align == 0  # each scalar-aligned
+
+
+def test_get_chunk_size_isa_scalar():
+    ec = make(4, 2, 5, scalar_mds="isa")
+    sub_mds = ErasureCodePluginRegistry.instance().factory(
+        "isa", {"k": "4", "m": "2", "technique": "reed_sol_van"})
+    scalar_align = sub_mds.get_chunk_size(1)
+    alignment = ec.get_sub_chunk_count() * 4 * scalar_align
+    for sw in (1, 5000, 1 << 18):
+        assert ec.get_chunk_size(sw) == -(-sw // alignment) * alignment // 4
+
+
+def test_scalar_mds_shec_constructs_and_roundtrips():
+    """scalar_mds=shec must construct (shec's 'technique' key means
+    single/multiple recovery and is NOT clay's MDS technique) and
+    round-trip; its chunk size follows the shec sub-code's alignment."""
+    ec = make(4, 2, 5, scalar_mds="shec")
+    sub = ErasureCodePluginRegistry.instance().factory(
+        "shec", {"k": "4", "m": "2", "c": "2", "w": "8"})
+    alignment = ec.get_sub_chunk_count() * 4 * sub.get_chunk_size(1)
+    assert ec.get_chunk_size(1) == alignment // 4
+    n = 6
+    data = roundtrip_data(ec, 3000)
+    encoded = ec.encode(set(range(n)), data)
+    avail = {i: encoded[i] for i in range(n) if i not in (0, 5)}
+    decoded = ec.decode({0, 5}, avail, len(encoded[0]))
+    assert decoded[0] == encoded[0] and decoded[5] == encoded[5]

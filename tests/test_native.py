@@ -1,0 +1,50 @@
+"""Native runtime under test: the pytest suite configures + builds
+native/ (cmake + ninja) and runs its ctest suite — the repo's L0 role
+(SURVEY.md §1) — so Python CI goes red if the C++ registry, plugins, the
+plugin=tpu embedded-CPython bridge, or the benchmark tools stop
+compiling, and the bridge's multithreaded GIL discipline is exercised
+on every run (native/tools/test_bridge_mt.cc; ctest TIMEOUT turns a
+GIL deadlock into a failure)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "native")
+BUILD = os.path.join(NATIVE, "build")
+
+
+def _run(cmd, **kw):
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=600, **kw)
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    r = _run(["cmake", "-S", NATIVE, "-B", BUILD, "-G", "Ninja"])
+    assert r.returncode == 0, f"cmake configure failed:\n{r.stdout}\n{r.stderr}"
+    r = _run(["ninja", "-C", BUILD])
+    assert r.returncode == 0, f"native build failed:\n{r.stdout}\n{r.stderr}"
+    return BUILD
+
+
+def test_native_builds(native_build):
+    for target in ("libceph_tpu_ec.so", "libec_rs.so", "libec_tpu.so",
+                   "ceph_erasure_code_benchmark", "test_bridge_mt"):
+        assert os.path.exists(os.path.join(native_build, target)), target
+
+
+def test_native_ctest(native_build):
+    """roundtrip_rs + roundtrip_example + bridge_multithreaded (the
+    plugin=tpu dlopen story end-to-end, from three threads)."""
+    env = dict(os.environ, CEPH_TPU_JAX_PLATFORM="cpu")
+    # the bridge embeds its own interpreter; don't leak the test
+    # process's XLA device-count flags into it
+    env.pop("XLA_FLAGS", None)
+    r = _run(["ctest", "--output-on-failure"], cwd=native_build, env=env)
+    assert r.returncode == 0, f"ctest failed:\n{r.stdout}\n{r.stderr}"
