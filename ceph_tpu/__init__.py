@@ -9,8 +9,8 @@ capabilities of the reference (agraf/ceph, a fork of ceph/ceph):
                           src/erasure-code/jerasure (reed_sol.c, cauchy.c,
                           liberation.c) and ISA-L (ec_base.c) algorithms.
 - ``ceph_tpu.ops``      — batched encode/decode compute paths: an XLA path
-                          (constant-multiplier XOR chains) and Pallas
-                          bit-plane MXU kernels.
+                          (constant-multiplier XOR chains) and a Pallas
+                          VMEM-resident SWAR kernel for w=8 matrix codes.
 - ``ceph_tpu.codes``    — the plugin framework: ErasureCodeInterface,
                           ErasureCode base class, plugin registry, and the
                           jerasure/isa/shec/clay/lrc-equivalent plugins

@@ -1,0 +1,243 @@
+"""Byte-stability non-regression corpus.
+
+Role of src/test/erasure-code/ceph_erasure_code_non_regression.cc +
+qa/workunits/erasure-code/encode-decode-non-regression.sh: encode a
+deterministic payload for every supported (plugin, profile) into a
+content-addressed directory; later versions re-encode and byte-compare.
+THE guard for "byte-identical parity" (SURVEY.md §4 byte-stability row,
+§7 step 4): stored parity must remain decodable forever, so any change
+to matrix generation, padding, or region math that alters even one
+parity byte turns the committed corpus red.
+
+Layout (one directory per profile under the corpus base):
+
+    <base>/<plugin>__<k=v joined by __>/
+        manifest.json   — profile, payload size/sha256, per-chunk sha256
+        content         — the deterministic payload
+        0, 1, ... n-1   — the encoded chunks
+
+CLI:
+    python -m ceph_tpu.bench.non_regression --base-dir tests/corpus --create
+    python -m ceph_tpu.bench.non_regression --base-dir tests/corpus --check
+    # single profile:
+    ... --plugin jerasure --parameter technique=reed_sol_van \
+        --parameter k=4 --parameter m=2 --create
+
+The standard matrix below covers every plugin and technique the
+framework ships; tests/test_non_regression.py re-checks the committed
+corpus on every pytest run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import itertools
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..codes.registry import ErasureCodePluginRegistry
+
+# every (plugin, profile) the corpus pins.  One entry per technique and
+# word size; ks/ms chosen to exercise the construction quirks
+# (systematization, packet layouts, sub-chunking, layered locality).
+STANDARD_MATRIX: List[Tuple[str, Dict[str, str]]] = [
+    ("example", {}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2",
+                  "w": "16"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "3", "m": "2",
+                  "w": "32"}),
+    ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "cauchy_orig", "k": "4", "m": "2",
+                  "packetsize": "32"}),
+    ("jerasure", {"technique": "cauchy_good", "k": "8", "m": "3",
+                  "packetsize": "32"}),
+    ("jerasure", {"technique": "liberation", "k": "4", "m": "2", "w": "7",
+                  "packetsize": "32"}),
+    ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2", "w": "6",
+                  "packetsize": "32"}),
+    ("jerasure", {"technique": "liber8tion", "k": "4", "m": "2",
+                  "packetsize": "32"}),
+    ("isa", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("isa", {"technique": "cauchy", "k": "8", "m": "3"}),
+    ("shec", {"k": "6", "m": "3", "c": "2"}),
+    ("shec", {"k": "4", "m": "3", "c": "2"}),
+    ("clay", {"k": "4", "m": "2", "d": "5"}),
+    ("clay", {"k": "8", "m": "4", "d": "11"}),
+    ("lrc", {"k": "4", "m": "2", "l": "3"}),
+    ("lrc", {"mapping": "__DD__DD",
+             "layers": '[["_cDD_cDD",""],["cDDD____",""],'
+                       '["____cDDD",""]]'}),
+]
+
+DEFAULT_SIZE = 24041  # odd, not chunk-aligned: exercises padding paths
+
+
+def profile_dir_name(plugin: str, profile: Dict[str, str]) -> str:
+    """Content-addressed directory name (profile order-independent)."""
+    parts = [plugin] + [f"{k}={profile[k]}" for k in sorted(profile)]
+    name = "__".join(parts)
+    # layers JSON etc. are not filesystem-safe; replace the offenders
+    for ch in '[]",/ ':
+        name = name.replace(ch, "-")
+    return name
+
+
+def _payload(name: str, size: int) -> bytes:
+    seed = int.from_bytes(hashlib.sha256(name.encode()).digest()[:8],
+                          "little")
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def _sha(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()
+
+
+def _factory(plugin: str, profile: Dict[str, str]):
+    return ErasureCodePluginRegistry.instance().factory(plugin,
+                                                        dict(profile))
+
+
+def create(plugin: str, profile: Dict[str, str], base_dir: str,
+           size: int = DEFAULT_SIZE) -> str:
+    name = profile_dir_name(plugin, profile)
+    d = os.path.join(base_dir, name)
+    os.makedirs(d, exist_ok=True)
+    ec = _factory(plugin, profile)
+    payload = _payload(name, size)
+    n = ec.get_chunk_count()
+    encoded = ec.encode(set(range(n)), payload)
+    with open(os.path.join(d, "content"), "wb") as f:
+        f.write(payload)
+    chunks = {}
+    for i in range(n):
+        with open(os.path.join(d, str(i)), "wb") as f:
+            f.write(encoded[i])
+        chunks[str(i)] = _sha(encoded[i])
+    manifest = {
+        "plugin": plugin,
+        "profile": profile,
+        "size": size,
+        "content_sha256": _sha(payload),
+        "chunk_sha256": chunks,
+    }
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return d
+
+
+def check(dirpath: str, decode_pairs: bool = True) -> List[str]:
+    """Re-encode and byte-compare against the stored corpus entry, then
+    decode the STORED chunks under erasures.  Returns a list of error
+    strings (empty = byte-stable and decodable)."""
+    errors: List[str] = []
+    with open(os.path.join(dirpath, "manifest.json")) as f:
+        manifest = json.load(f)
+    plugin = manifest["plugin"]
+    profile = manifest["profile"]
+    size = manifest["size"]
+    ec = _factory(plugin, profile)
+    with open(os.path.join(dirpath, "content"), "rb") as f:
+        payload = f.read()
+    if _sha(payload) != manifest["content_sha256"]:
+        errors.append(f"{dirpath}: payload corrupted on disk")
+        return errors
+    n = ec.get_chunk_count()
+    stored = {}
+    for i in range(n):
+        with open(os.path.join(dirpath, str(i)), "rb") as f:
+            stored[i] = f.read()
+    # 1. byte-stability: today's encode must reproduce the archive
+    encoded = ec.encode(set(range(n)), payload)
+    for i in range(n):
+        if encoded[i] != stored[i]:
+            errors.append(
+                f"{dirpath}: chunk {i} re-encode differs from archive "
+                f"({_sha(encoded[i])[:12]} != {_sha(stored[i])[:12]})")
+    # 2. stored data stays decodable: single erasures always, pairs for
+    #    small codes (mirrors the reference's erasure sweep)
+    k = ec.get_data_chunk_count()
+    chunk_size = len(stored[0])
+    patterns = [(i,) for i in range(n)]
+    if decode_pairs and n <= 12 and ec.get_coding_chunk_count() >= 2:
+        patterns += list(itertools.combinations(range(n), 2))
+    for erased in patterns:
+        avail = {i: stored[i] for i in range(n) if i not in erased}
+        want = set(erased)
+        try:
+            need = ec.minimum_to_decode(want, set(avail))
+            decoded = ec.decode(want, {i: avail[i] for i in need
+                                       if i in avail} or avail, chunk_size)
+        except Exception as e:  # non-MDS codes may not cover a pattern
+            if len(erased) > ec.get_coding_chunk_count():
+                continue
+            try:  # full-availability fallback mirrors the reference
+                decoded = ec.decode(want, avail, chunk_size)
+            except Exception:
+                errors.append(f"{dirpath}: decode {erased} raised {e!r}")
+                continue
+        for c in erased:
+            if c not in decoded:
+                errors.append(
+                    f"{dirpath}: decode {erased} did not produce chunk {c}")
+            elif decoded[c] != stored[c]:
+                errors.append(
+                    f"{dirpath}: decode {erased} chunk {c} mismatch")
+    # 3. payload reassembly
+    data_chunks = b"".join(stored[i] for i in range(k))
+    if data_chunks[:size] != payload:
+        mapping = ec.get_chunk_mapping()
+        if not mapping:  # systematic codes must carry payload verbatim
+            errors.append(f"{dirpath}: data chunks do not carry payload")
+    return errors
+
+
+def corpus_dirs(base_dir: str) -> List[str]:
+    return sorted(
+        os.path.join(base_dir, d) for d in os.listdir(base_dir)
+        if os.path.isfile(os.path.join(base_dir, d, "manifest.json")))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--base-dir", required=True)
+    ap.add_argument("--create", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--plugin")
+    ap.add_argument("--parameter", "-P", action="append", default=[])
+    ap.add_argument("--size", type=int, default=DEFAULT_SIZE)
+    args = ap.parse_args(argv)
+    if args.create:
+        if args.plugin:
+            profile = dict(p.split("=", 1) for p in args.parameter)
+            d = create(args.plugin, profile, args.base_dir, args.size)
+            print(f"created {d}")
+        else:
+            for plugin, profile in STANDARD_MATRIX:
+                d = create(plugin, profile, args.base_dir, args.size)
+                print(f"created {d}")
+        return 0
+    if args.check:
+        failures = []
+        for d in corpus_dirs(args.base_dir):
+            errs = check(d)
+            status = "FAIL" if errs else "ok"
+            print(f"{status} {os.path.basename(d)}")
+            failures.extend(errs)
+        for e in failures:
+            print(e, file=sys.stderr)
+        return 1 if failures else 0
+    ap.error("one of --create / --check required")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

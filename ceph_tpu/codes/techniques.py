@@ -23,6 +23,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import regionops
+from ..ops.pallas_gf import apply_matrix_best
 from ..ops.xla_ops import (
     apply_bitmatrix_xla,
     apply_matrix_xla,
@@ -55,7 +56,7 @@ class MatrixCodeMixin:
         if chunks.nbytes < self.min_xla_bytes:
             return regionops.matrix_encode(words, matrix, self.w).view(np.uint8)
         return np.asarray(
-            apply_matrix_xla(words, matrix_static, self.w)).view(np.uint8)
+            apply_matrix_best(words, matrix_static, self.w)).view(np.uint8)
 
     def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
         return self._apply(data, self.matrix, self._matrix_static)
@@ -85,7 +86,7 @@ class MatrixCodeMixin:
         """(batch, k, C) uint8 device array -> (batch, m, C) parity on device."""
         words = jax_words_view(data, self.w)
         return jax_bytes_view(
-            apply_matrix_xla(words, self._matrix_static, self.w))
+            apply_matrix_best(words, self._matrix_static, self.w))
 
     def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
         """(batch, len(available), C) device array -> (batch, len(erased), C)."""
@@ -93,7 +94,7 @@ class MatrixCodeMixin:
             raise IOError(f"need {self.k} chunks, have {len(available)}")
         _, dm_static, ns = self._decode_matrix(tuple(available), tuple(erased))
         words = jax_words_view(chunks[..., :ns, :], self.w)
-        return jax_bytes_view(apply_matrix_xla(words, dm_static, self.w))
+        return jax_bytes_view(apply_matrix_best(words, dm_static, self.w))
 
 
 class BitmatrixCodeMixin:

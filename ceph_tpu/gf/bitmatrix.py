@@ -11,9 +11,10 @@ Replicates jerasure's bit-matrix machinery (SURVEY.md §2.1 "jerasure
 - jerasure/src/jerasure.c -> jerasure_invert_bitmatrix: GF(2) inversion
   for bitmatrix decode (gf2_invert / gf2_rank below).
 
-The bit-matrix form is also the TPU-native representation: multiplying by a
-constant becomes w XOR-accumulated bit-plane selections, i.e. a GF(2) matmul
-that maps straight onto the MXU (see ceph_tpu.ops.pallas_gf).
+The bit-matrix form is also a TPU-friendly representation: multiplying by
+a constant becomes w XOR-accumulated bit-plane selections (the packet
+layout the XLA bitmatrix path executes, ceph_tpu.ops.xla_ops ->
+apply_bitmatrix_xla).
 """
 
 from __future__ import annotations

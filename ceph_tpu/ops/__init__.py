@@ -5,7 +5,9 @@ Three tiers, all byte-identical:
                    region ops play for jerasure: the ground truth).
 - ``xla_ops``    — jit-compiled JAX paths built from XOR/shift chains
                    (no gathers; TPU- and CPU-safe).
-- ``pallas_gf``  — Pallas bit-plane MXU kernels (the performance path).
+- ``pallas_gf``  — Pallas VMEM-resident SWAR kernels (the TPU
+                   performance path for w=8 matrix codes; dispatched
+                   by ``apply_matrix_best``).
 """
 
 from .regionops import (
@@ -19,4 +21,9 @@ from .xla_ops import (
     apply_matrix_xla,
     encode_bitmatrix_xla,
     apply_bitmatrix_xla,
+)
+from .pallas_gf import (
+    apply_matrix_best,
+    apply_matrix_pallas,
+    pallas_matrix_supported,
 )

@@ -1,0 +1,151 @@
+"""Pallas GF(2^8) region kernels — the device performance path.
+
+SURVEY.md §7 step 3 (north star: "GF(2^8) Reed-Solomon / Cauchy matrix
+multiplies as Pallas bit-sliced kernels").  Replaces, at the math level,
+gf-complete's SIMD region ops (src/erasure-code/jerasure/gf-complete ->
+gf_w8_split_multiply_region_sse family) with a VMEM-resident SWAR
+kernel:
+
+- Bytes stay SWAR-packed, 4 independent GF(2^8) field bytes per uint32
+  VPU lane (TPUs have no byte gather; 32-bit lanes are native).
+- Each grid step holds one (k, TILE) tile of the stripe batch in VMEM,
+  computes the xtime doubling planes x^t * chunk_j in registers, and
+  XOR-folds them straight into the m parity accumulators — data is read
+  from HBM once and parity written once, with NO intermediate plane
+  materialization.  (The XLA fallback in xla_ops.py expresses the same
+  math, but at multi-MiB batch sizes XLA materializes doubling planes
+  between fusions, which caps it far below HBM bandwidth.)
+- The coding matrix is STATIC: the kernel is specialized (fully
+  unrolled xtime/XOR schedule) per matrix, like jerasure's
+  smart-schedule specialization per bitmatrix.
+
+Byte-identity: pinned against ops/regionops.py (the host ground truth)
+in tests/test_pallas.py, in interpreter mode on CPU and compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# the one SWAR doubling primitive, shared with the XLA path so the two
+# engines can never diverge
+from .xla_ops import xtime_swar8 as _xtime_swar
+
+LANE = 128          # TPU lane width
+MAX_ROW_TILE = 64   # uint32 rows of 128 lanes per block: 32 KiB per chunk
+
+
+def _gf8_matrix_kernel(matrix_t, s: int, r: int):
+    """Build the specialized kernel body for a static (r, s) GF(2^8)
+    matrix: per input chunk j, walk the xtime doubling chain once and
+    XOR plane t into every accumulator i whose matrix[i][j] has bit t."""
+
+    def kernel(in_ref, out_ref):
+        accs = [None] * r
+        for j in range(s):
+            col = [matrix_t[i][j] for i in range(r)]
+            top = max((c.bit_length() for c in col), default=0)
+            if top == 0:
+                continue
+            plane = in_ref[0, j]
+            for t in range(top):
+                if t > 0:
+                    plane = _xtime_swar(plane)
+                for i in range(r):
+                    if (col[i] >> t) & 1:
+                        accs[i] = plane if accs[i] is None else accs[i] ^ plane
+        zero = None
+        for i in range(r):
+            if accs[i] is None:
+                if zero is None:
+                    zero = jnp.zeros_like(in_ref[0, 0])
+                accs[i] = zero
+            out_ref[0, i] = accs[i]
+
+    return kernel
+
+
+def _row_tile(rows: int) -> int:
+    """Largest multiple of 8 that divides ``rows``, capped at 64 (the
+    (8, 128) int32 VMEM tile requires multiple-of-8 sublane blocks);
+    0 when no such divisor exists (caller falls back to XLA)."""
+    for cand in range(MAX_ROW_TILE, 7, -8):
+        if cand <= rows and rows % cand == 0:
+            return cand
+    return 0
+
+
+def pallas_matrix_supported(shape, w: int) -> bool:
+    """True when (..., s, C) uint8 chunks fit the kernel's tiling: w=8
+    and C a multiple of 4*128*8 words (every SIMD-aligned chunk size
+    >= 4 KiB qualifies; others fall back to the XLA path)."""
+    if w != 8 or len(shape) < 2:
+        return False
+    c = shape[-1]
+    if c % (4 * LANE) != 0:
+        return False
+    return _row_tile(c // (4 * LANE)) != 0
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def apply_matrix_pallas(chunks: jax.Array, matrix_t,
+                        interpret: bool = False) -> jax.Array:
+    """Apply a static (r, s) GF(2^8) matrix to (..., s, C) uint8 chunks
+    -> (..., r, C) parity/decode output.  Same contract as
+    xla_ops.apply_matrix_xla (w=8); caller gates on
+    pallas_matrix_supported."""
+    r = len(matrix_t)
+    s = len(matrix_t[0])
+    assert chunks.shape[-2] == s and chunks.dtype == jnp.uint8
+    lead = chunks.shape[:-2]
+    c = chunks.shape[-1]
+    c4 = c // 4
+    rows = c4 // LANE
+    rt = _row_tile(rows)
+    b = int(np.prod(lead)) if lead else 1
+    words = jax.lax.bitcast_convert_type(
+        chunks.reshape(b, s, c4, 4), jnp.uint32).reshape(b, s, rows, LANE)
+    out = pl.pallas_call(
+        _gf8_matrix_kernel(matrix_t, s, r),
+        grid=(b, rows // rt),
+        in_specs=[pl.BlockSpec((1, s, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, r, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, r, rows, LANE), jnp.uint32),
+        interpret=interpret,
+    )(words)
+    out = jax.lax.bitcast_convert_type(out.reshape(b, r, c4, 1), jnp.uint8)
+    return out.reshape(lead + (r, c))
+
+
+def _device_kind() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:  # pragma: no cover - backend probing never raises
+        return "cpu"
+
+
+def use_pallas() -> bool:
+    """The kernel lowers through Mosaic for TPU backends only (the
+    axon tunnel reports backend "tpu" too); every other backend —
+    cpu, gpu — takes the XLA path (interpreter mode is for tests)."""
+    return _device_kind() == "tpu"
+
+
+def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
+    """Dispatch: Pallas kernel on TPU for supported w=8 shapes, XLA
+    otherwise.  Byte-identical either way (cross-pinned in tests)."""
+    from .xla_ops import apply_matrix_xla
+    if (w == 8 and chunks.dtype == jnp.uint8 and use_pallas()
+            and pallas_matrix_supported(chunks.shape, w)):
+        return apply_matrix_pallas(chunks, matrix_t)
+    return apply_matrix_xla(chunks, matrix_t, w)

@@ -45,17 +45,21 @@ def _xtime(v: jax.Array, w: int = 8) -> jax.Array:
     return ((v << dt(1)) ^ (hi * fb)).astype(dt)
 
 
-def _xtime_swar8(v: jax.Array) -> jax.Array:
+def xtime_swar8(v: jax.Array) -> jax.Array:
     """xtime on uint32 lanes each packing 4 independent GF(2^8) bytes.
 
     TPU VPU lanes are 32-bit; uint8 elementwise ops occupy a full lane per
     byte. Packing 4 field bytes per lane quadruples throughput. Per-byte
     independence: MSBs are cleared before the shift (no cross-byte carry)
     and the feedback multiply (hi>>7)*0x1d stays within each byte.
+    Shared by the XLA path below and the Pallas kernel (ops/pallas_gf.py).
     """
     hi = v & jnp.uint32(0x80808080)
     return ((v ^ hi) << jnp.uint32(1)) ^ ((hi >> jnp.uint32(7))
                                           * jnp.uint32(GF8_FEEDBACK))
+
+
+_xtime_swar8 = xtime_swar8
 
 
 from ..gf.gf8 import GF8_POLY

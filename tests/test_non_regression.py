@@ -1,0 +1,51 @@
+"""Byte-stability non-regression — the committed corpus must re-encode
+byte-identically on every run (mirrors
+src/test/erasure-code/ceph_erasure_code_non_regression.cc +
+encode-decode-non-regression.sh).  Any change to matrix generation,
+padding, or region math that alters one stored-parity byte fails here.
+Regenerate ONLY for an intentional format change:
+    python -m ceph_tpu.bench.non_regression --base-dir tests/corpus --create
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from ceph_tpu.bench import non_regression
+
+CORPUS = os.path.join(os.path.dirname(__file__), "corpus")
+DIRS = non_regression.corpus_dirs(CORPUS) if os.path.isdir(CORPUS) else []
+
+
+def test_corpus_covers_standard_matrix():
+    names = {os.path.basename(d) for d in DIRS}
+    for plugin, profile in non_regression.STANDARD_MATRIX:
+        assert non_regression.profile_dir_name(plugin, profile) in names, (
+            plugin, profile, "run the corpus writer and commit the result")
+
+
+@pytest.mark.parametrize("dirpath", DIRS,
+                         ids=[os.path.basename(d) for d in DIRS])
+def test_byte_stability(dirpath):
+    errors = non_regression.check(dirpath)
+    assert not errors, "\n".join(errors)
+
+
+def test_checker_detects_parity_drift(tmp_path):
+    """The guard itself must work: flipping one archived parity byte
+    (or one payload byte, changing the expected encode) turns the
+    check red."""
+    src = os.path.join(CORPUS, non_regression.profile_dir_name(
+        "jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"}))
+    d = tmp_path / "tampered"
+    shutil.copytree(src, d)
+    with open(d / "manifest.json") as f:
+        n_chunks = len(json.load(f)["chunk_sha256"])
+    parity = d / str(n_chunks - 1)
+    raw = bytearray(parity.read_bytes())
+    raw[0] ^= 0xFF
+    parity.write_bytes(bytes(raw))
+    errors = non_regression.check(str(d))
+    assert any("re-encode differs" in e for e in errors)
