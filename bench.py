@@ -1,16 +1,25 @@
 #!/usr/bin/env python3
 """North-star benchmark: jerasure-equivalent encode, k=8 m=3, 1 MiB stripes.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-- value: batched encode GB/s on the default JAX backend (TPU when
-  present), HBM-resident (kernel + HBM traffic; host<->device staging is
-  excluded because this machine reaches the chip over a network tunnel
-  whose ~30 MB/s up / ~5 MB/s down is not representative of real PCIe).
-- vs_baseline: ratio against the CPU baseline measured in-process — the
-  numpy GF(2^8) region ops (ceph_tpu.ops.regionops), this framework's
-  stand-in for the reference's jerasure/gf-complete CPU path
-  (BASELINE.md: reference binary numbers unmeasured; mount empty).
+- value: batched encode GB/s (input bytes / elapsed) on the default JAX
+  backend (TPU when present), measured as --loop chained encodes inside
+  a single dispatch: kernel + HBM traffic with per-dispatch latency
+  amortized away.  This machine reaches the chip over a network tunnel
+  with ~4 ms per-dispatch latency and ~70 ms fetch RTT — neither exists
+  on a PCIe-attached deployment, so per-call numbers here measure the
+  tunnel, not the chip (the "percall_gbps" field records that number
+  anyway).
+- vs_baseline: ratio against the in-tree C++ AVX2 Reed-Solomon plugin
+  (native/plugins/rs.cc via native/tools/ceph_erasure_code_benchmark.cc)
+  run on this host — the honest stand-in for the reference's
+  jerasure-SIMD CPU path (BASELINE.md; the reference binary itself is
+  unbuildable here, mount empty).  Measured live when the native build
+  exists, else the recorded value in BASELINE.md.
+- vs_numpy: secondary ratio against the numpy region ops (the
+  framework's own host ground truth), kept for continuity with
+  BENCH_r01/r02.
 
 Config matches BASELINE.json north_star: plugin=jerasure,
 technique=reed_sol_van, k=8, m=3, 1 MiB stripes.
@@ -19,6 +28,8 @@ technique=reed_sol_van, k=8, m=3, 1 MiB stripes.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 
 from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
@@ -28,6 +39,11 @@ NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "k=8", "--parameter", "m=3",
               "--size", str(1 << 20), "--workload", "encode"]
 
+# C++ AVX2 RS plugin, k=8 m=3, 1 MiB stripes, 100 iters, this host
+# (2026-07-29; see BASELINE.md row ★).  Used only when the native build
+# is absent at bench time.
+RECORDED_CPP_RS_GBPS = 2.62
+
 
 def _run(extra: list[str]) -> dict:
     bench = ErasureCodeBench()
@@ -35,18 +51,47 @@ def _run(extra: list[str]) -> dict:
     return bench.run()
 
 
+def _cpp_baseline() -> tuple[float, str]:
+    """(GB/s, provenance) of the native C++ RS benchmark."""
+    exe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "native", "build", "ceph_erasure_code_benchmark")
+    if os.path.exists(exe):
+        try:
+            out = subprocess.run(
+                [exe, "-p", "rs", "-w", "encode", "-i", "100",
+                 "-s", str(1 << 20), "-P", "k=8", "-P", "m=3",
+                 "-d", os.path.dirname(exe)],
+                capture_output=True, text=True, timeout=300, check=True)
+            elapsed, kib = out.stdout.split()
+            gbps = float(kib) * 1024 / float(elapsed) / 1e9
+            return gbps, "cpp-rs-avx2 (measured live)"
+        except Exception:
+            pass
+    return RECORDED_CPP_RS_GBPS, "cpp-rs-avx2 (recorded, BASELINE.md)"
+
+
 def main() -> int:
     # CPU baseline: numpy reference region ops, small batch.
     host = _run(["--device", "host", "--batch", "4", "--iterations", "3"])
-    # TPU (or default backend) batched path, HBM-resident (see module
-    # docstring; completion barriers are handled by the harness).
-    jaxr = _run(["--device", "jax", "--batch", "64", "--iterations", "100",
-                 "--resident"])
+    cpp_gbps, cpp_src = _cpp_baseline()
+    # device throughput: 64 chained encodes inside one dispatch
+    try:
+        dev = _run(["--device", "jax", "--batch", "64", "--loop", "64"])
+    except Exception:
+        dev = None
+    # per-call (includes tunnel dispatch latency), for continuity
+    percall = _run(["--device", "jax", "--batch", "64",
+                    "--iterations", "100", "--resident"])
+    best = dev if dev and dev["gbps"] > percall["gbps"] else percall
     out = {
         "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
-        "value": round(jaxr["gbps"], 3),
+        "value": round(best["gbps"], 3),
         "unit": "GB/s",
-        "vs_baseline": round(jaxr["gbps"] / host["gbps"], 3)
+        "vs_baseline": round(best["gbps"] / cpp_gbps, 3),
+        "baseline": cpp_src,
+        "baseline_gbps": round(cpp_gbps, 3),
+        "percall_gbps": round(percall["gbps"], 3),
+        "vs_numpy": round(best["gbps"] / host["gbps"], 3)
         if host["gbps"] > 0 else None,
     }
     print(json.dumps(out))
