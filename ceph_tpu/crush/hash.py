@@ -82,8 +82,13 @@ def crush_hash32(a):
     a = _u32(a)
     h = _SEED ^ a
     b = a
-    _, _, h = _mix(b, _X, h)
-    _, _, h = _mix(_Y, a, h)
+    # crush_hashmix is an in-place macro upstream: x and y are MUTATED
+    # by each mix and the mutated values feed later mixes.  Thread them
+    # through exactly (pinned against the independent C reference,
+    # tests/test_crush_kat.py).
+    x, y = _X, _Y
+    b, x, h = _mix(b, x, h)
+    y, a, h = _mix(y, a, h)
     return h
 
 
@@ -92,9 +97,10 @@ def crush_hash32_2(a, b):
     """hash.c -> crush_hash32_rjenkins1_2."""
     a, b = _u32(a), _u32(b)
     h = _SEED ^ a ^ b
+    x, y = _X, _Y
     a, b, h = _mix(a, b, h)
-    _, a, h = _mix(_X, a, h)
-    _, _, h = _mix(b, _Y, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
     return h
 
 
@@ -103,11 +109,12 @@ def crush_hash32_3(a, b, c):
     """hash.c -> crush_hash32_rjenkins1_3."""
     a, b, c = _u32(a), _u32(b), _u32(c)
     h = _SEED ^ a ^ b ^ c
+    x, y = _X, _Y
     a, b, h = _mix(a, b, h)
-    c, _, h = _mix(c, _X, h)
-    _, a, h = _mix(_Y, a, h)
-    b, _, h = _mix(b, _X, h)
-    _, c, h = _mix(_Y, c, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)  # x as mutated by the second mix
+    y, c, h = _mix(y, c, h)  # y as mutated by the third mix
     return h
 
 
@@ -116,12 +123,13 @@ def crush_hash32_4(a, b, c, d):
     """hash.c -> crush_hash32_rjenkins1_4."""
     a, b, c, d = _u32(a), _u32(b), _u32(c), _u32(d)
     h = _SEED ^ a ^ b ^ c ^ d
+    x, y = _X, _Y
     a, b, h = _mix(a, b, h)
     c, d, h = _mix(c, d, h)
-    a, _, h = _mix(a, _X, h)
-    _, b, h = _mix(_Y, b, h)
-    c, _, h = _mix(c, _X, h)
-    _, d, h = _mix(_Y, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)  # x as mutated above
+    y, d, h = _mix(y, d, h)  # y as mutated above
     return h
 
 
@@ -130,11 +138,12 @@ def crush_hash32_5(a, b, c, d, e):
     """hash.c -> crush_hash32_rjenkins1_5."""
     a, b, c, d, e = _u32(a), _u32(b), _u32(c), _u32(d), _u32(e)
     h = _SEED ^ a ^ b ^ c ^ d ^ e
+    x, y = _X, _Y
     a, b, h = _mix(a, b, h)
     c, d, h = _mix(c, d, h)
-    e, _, h = _mix(e, _X, h)
-    _, a, h = _mix(_Y, a, h)
-    b, _, h = _mix(b, _X, h)
-    _, c, h = _mix(_Y, c, h)
-    d, _, h = _mix(d, _X, h)
+    e, x, h = _mix(e, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)  # x as mutated above
+    y, c, h = _mix(y, c, h)  # y as mutated above
+    d, x, h = _mix(d, x, h)
     return h

@@ -1,0 +1,203 @@
+/* Independent known-answer reference for the CRUSH primitives.
+ *
+ * Written in C, directly from the upstream algorithm definitions
+ * (src/crush/hash.c rjenkins1, src/crush/mapper.c crush_ln +
+ * bucket_straw2_choose), as a SECOND transcription that shares no code
+ * with ceph_tpu/crush/{hash,ln,mapper}.py: the Python package must
+ * reproduce every vector this program emits (tests/test_crush_kat.py
+ * compiles and runs it at test time).  A transposed line in either
+ * transcription makes the two disagree.
+ *
+ * crush_ln's lookup tables are generated here with long double
+ * arithmetic (the Python generates them with 50-digit Decimal); exact
+ * integer agreement of all 514 table-derived values is required.
+ *
+ * Output: one "name value" pair per line, deterministic order.
+ */
+
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+
+/* ---- rjenkins1 (hash.c) ---------------------------------------- */
+
+#define MIX(a, b, c)            \
+  do {                          \
+    a = a - b;  a = a - c;  a = a ^ (c >> 13); \
+    b = b - c;  b = b - a;  b = b ^ (a << 8);  \
+    c = c - a;  c = c - b;  c = c ^ (b >> 13); \
+    a = a - b;  a = a - c;  a = a ^ (c >> 12); \
+    b = b - c;  b = b - a;  b = b ^ (a << 16); \
+    c = c - a;  c = c - b;  c = c ^ (b >> 5);  \
+    a = a - b;  a = a - c;  a = a ^ (c >> 3);  \
+    b = b - c;  b = b - a;  b = b ^ (a << 10); \
+    c = c - a;  c = c - b;  c = c ^ (b >> 15); \
+  } while (0)
+
+static const uint32_t SEED = 1315423911u;
+
+static uint32_t h1(uint32_t a) {
+  uint32_t hash = SEED ^ a, b = a, x = 231232u, y = 1232u;
+  MIX(b, x, hash);
+  MIX(y, a, hash);
+  return hash;
+}
+
+static uint32_t h2(uint32_t a, uint32_t b) {
+  uint32_t hash = SEED ^ a ^ b, x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(x, a, hash);
+  MIX(b, y, hash);
+  return hash;
+}
+
+static uint32_t h3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t hash = SEED ^ a ^ b ^ c, x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(c, x, hash);
+  MIX(y, a, hash);
+  MIX(b, x, hash);
+  MIX(y, c, hash);
+  return hash;
+}
+
+static uint32_t h4(uint32_t a, uint32_t b, uint32_t c, uint32_t d) {
+  uint32_t hash = SEED ^ a ^ b ^ c ^ d, x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(c, d, hash);
+  MIX(a, x, hash);
+  MIX(y, b, hash);
+  MIX(c, x, hash);
+  MIX(y, d, hash);
+  return hash;
+}
+
+static uint32_t h5(uint32_t a, uint32_t b, uint32_t c, uint32_t d,
+                   uint32_t e) {
+  uint32_t hash = SEED ^ a ^ b ^ c ^ d ^ e, x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(c, d, hash);
+  MIX(e, x, hash);
+  MIX(y, a, hash);
+  MIX(b, x, hash);
+  MIX(y, c, hash);
+  MIX(d, x, hash);
+  return hash;
+}
+
+/* ---- crush_ln (mapper.c + crush_ln_table.h, tables regenerated) -- */
+
+/* RH[i]: ceil(2^56 / index1), LH[i]: round(2^48 * log2(index1/256))
+ * for even index1 in [256, 512]; LL[i]: round(2^48 * log2(1 + i/2^15)).
+ * Generated with long double log2l (64-bit mantissa: the values need
+ * ~48 significant bits, so long double is exact enough to round
+ * correctly everywhere the spacing from a half-integer exceeds ~2^-15,
+ * which holds for these arguments). */
+static int64_t RH[129], LH[129], LL[256];
+
+static void gen_tables(void) {
+  int i;
+  for (i = 0; i < 129; i++) {
+    int64_t index1 = 256 + 2 * i;
+    RH[i] = ((__int128)1 << 56) / index1;
+    if (((__int128)1 << 56) % index1) RH[i] += 1; /* ceil */
+    LH[i] = (int64_t)roundl(powl(2.0L, 48) * log2l((long double)index1 / 256.0L));
+  }
+  for (i = 0; i < 256; i++)
+    LL[i] = (int64_t)roundl(powl(2.0L, 48) *
+                            log2l(1.0L + (long double)i / 32768.0L));
+}
+
+static int64_t crush_ln(uint32_t xin) {
+  uint64_t x = (uint64_t)xin + 1, v;
+  int iexpon = 15;
+  int64_t rh, lh, ll, result;
+  uint64_t index1, index2;
+  v = x;
+  while (v < 0x8000) { /* normalize into [2^15, 2^16] */
+    v <<= 1;
+    iexpon -= 1;
+  }
+  /* upstream indexes the interleaved table at index1 = (v>>8)<<1 in
+   * [256, 512]; with split even/odd arrays that is slot (v>>8) - 128 */
+  index1 = v >> 8;
+  rh = RH[index1 - 128];
+  lh = LH[index1 - 128];
+  index2 = ((unsigned __int128)v * (uint64_t)rh >> 48) & 0xff;
+  ll = LL[index2];
+  result = (int64_t)iexpon << 44;
+  result += (lh + ll) >> 4; /* 2^48 -> 2^44 fixed point */
+  return result;
+}
+
+/* ---- straw2 selection (mapper.c -> bucket_straw2_choose) --------- */
+
+static int straw2_choose(uint32_t x, uint32_t r, const int *ids,
+                         const int64_t *weights, int n) {
+  int i, high = 0;
+  int64_t high_draw = INT64_MIN, draw, ln;
+  uint32_t u;
+  for (i = 0; i < n; i++) {
+    if (weights[i]) {
+      u = h3(x, (uint32_t)ids[i], r) & 0xffff;
+      ln = crush_ln(u) - 0x1000000000000ll;
+      draw = ln / weights[i];
+    } else {
+      draw = INT64_MIN;
+    }
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return high;
+}
+
+/* ---- vector emission -------------------------------------------- */
+
+/* tiny deterministic generator (numerical recipes LCG), independent of
+ * everything above */
+static uint32_t lcg_state = 20260729u;
+static uint32_t lcg(void) {
+  lcg_state = lcg_state * 1664525u + 1013904223u;
+  return lcg_state;
+}
+
+int main(void) {
+  int i;
+  gen_tables();
+
+  /* fixed + random hash vectors, all arities */
+  uint32_t fixed[] = {0u, 1u, 2u, 0xffffffffu, 0x12345678u, 715827882u};
+  for (i = 0; i < 6; i++) printf("h1 %u %u\n", fixed[i], h1(fixed[i]));
+  for (i = 0; i < 64; i++) {
+    uint32_t a = lcg(), b = lcg(), c = lcg(), d = lcg(), e = lcg();
+    printf("h1 %u %u\n", a, h1(a));
+    printf("h2 %u %u %u\n", a, b, h2(a, b));
+    printf("h3 %u %u %u %u\n", a, b, c, h3(a, b, c));
+    printf("h4 %u %u %u %u %u\n", a, b, c, d, h4(a, b, c, d));
+    printf("h5 %u %u %u %u %u %u\n", a, b, c, d, e, h5(a, b, c, d, e));
+  }
+
+  /* crush_ln over the full straw2 domain boundary cases + sweep */
+  for (i = 0; i <= 0xffff; i += 17)
+    printf("ln %d %lld\n", i, (long long)crush_ln((uint32_t)i));
+  printf("ln 65535 %lld\n", (long long)crush_ln(0xffffu));
+
+  /* straw2 winners over random weight sets */
+  for (i = 0; i < 200; i++) {
+    int n = 2 + (int)(lcg() % 7), j;
+    int ids[8];
+    int64_t w[8];
+    for (j = 0; j < n; j++) {
+      ids[j] = (int)(lcg() % 1000);
+      w[j] = (int64_t)(lcg() % 0x40000); /* up to 4.0 in 16.16 */
+    }
+    if (i % 5 == 0) w[lcg() % n] = 0; /* zero-weight path */
+    uint32_t x = lcg(), r = lcg() % 16;
+    printf("s2 %u %u %d", x, r, n);
+    for (j = 0; j < n; j++) printf(" %d %lld", ids[j], (long long)w[j]);
+    printf(" -> %d\n", straw2_choose(x, r, ids, w, n));
+  }
+  return 0;
+}

@@ -22,6 +22,12 @@ def main():
         json.dump(golden, f, indent=1, sort_keys=True)
     print(f"wrote {GOLDEN}")
 
+    import test_crush_golden
+    with open(test_crush_golden.GOLDEN, "w") as f:
+        json.dump(test_crush_golden.generate(), f, indent=1,
+                  sort_keys=True)
+    print(f"wrote {test_crush_golden.GOLDEN}")
+
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(__file__))

@@ -1,0 +1,89 @@
+"""Committed golden mappings: a fixed map per bucket algorithm
+(uniform / list / tree / straw / straw2), both choose modes, plus a
+reweight case.  Any change to the hash, crush_ln, bucket choose math,
+or the rule interpreter shows up as a golden diff (regenerate with
+tests/make_golden.py ONLY for an intentional mapping change — mappings
+moving means data moves on real clusters).  When the reference mount is
+repaired these files are the artifacts to diff against
+`crushtool --test --show-mappings` output (SURVEY.md §0 protocol).
+"""
+
+import json
+import os
+
+import pytest
+
+from ceph_tpu.crush import (
+    CrushBuilder,
+    crush_do_rule,
+    step_chooseleaf_firstn,
+    step_chooseleaf_indep,
+    step_emit,
+    step_take,
+)
+
+ALGS = ["uniform", "list", "tree", "straw", "straw2"]
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "bucket_algs.json")
+
+
+def _alg_maps():
+    """One two-level map per bucket algorithm; uniform gets equal
+    weights (its contract), the others get a ragged weight spread."""
+    out = []
+    for alg in ALGS:
+        b = CrushBuilder()
+        b.add_type(1, "host")
+        b.add_type(2, "root")
+        hosts = []
+        for h in range(4):
+            devs = list(range(h * 3, h * 3 + 3))
+            if alg == "uniform":
+                ws = [0x10000] * 3
+            else:
+                ws = [0x8000 + 0x4000 * ((h + i) % 3) for i in range(3)]
+            hosts.append(b.add_bucket(alg, "host", devs, ws))
+        root = b.add_bucket(alg, "root", hosts)
+        b.add_rule(0, [step_take(root), step_chooseleaf_firstn(0, 1),
+                       step_emit()])
+        b.add_rule(1, [step_take(root), step_chooseleaf_indep(0, 1),
+                       step_emit()])
+        out.append((alg, b))
+    return out
+
+
+def _mappings(b, weight=None):
+    return {
+        "firstn": [crush_do_rule(b.map, 0, x, 3, weight=weight)
+                   for x in range(64)],
+        "indep": [crush_do_rule(b.map, 1, x, 3, weight=weight)
+                  for x in range(64)],
+    }
+
+
+def generate():
+    golden = {}
+    for alg, b in _alg_maps():
+        golden[alg] = _mappings(b)
+        if alg == "straw2":
+            w = b.map.device_weights()
+            w[0] = 0
+            w[5] = 0x8000
+            golden["straw2_reweight"] = _mappings(b, weight=w)
+    return golden
+
+
+@pytest.mark.parametrize("alg", ALGS + ["straw2_reweight"])
+def test_bucket_alg_golden(alg):
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert golden[alg] == generate()[alg], (
+        alg, "mapping change — placements move on real clusters; "
+        "regenerate via tests/make_golden.py only if intentional")
+
+
+def test_all_replicas_distinct_across_algs():
+    for alg, b in _alg_maps():
+        for x in range(64):
+            res = crush_do_rule(b.map, 0, x, 3)
+            assert len(set(res)) == len(res), (alg, x, res)
