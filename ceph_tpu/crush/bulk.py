@@ -47,6 +47,7 @@ from .mapper import crush_do_rule
 from .types import (
     CRUSH_BUCKET_STRAW2,
     CRUSH_ITEM_NONE,
+    ChooseArg,
     CRUSH_RULE_CHOOSELEAF_FIRSTN,
     CRUSH_RULE_CHOOSELEAF_INDEP,
     CRUSH_RULE_CHOOSE_FIRSTN,
@@ -68,36 +69,64 @@ _NEGLN = (1 << 48) - np.asarray(crush_ln(np.arange(0x10000)))
 
 
 class CompiledCrushMap:
-    """Dense-array form of a straw2 CrushMap for the fused evaluator."""
+    """Dense-array form of a straw2 CrushMap for the fused evaluator.
 
-    def __init__(self, cmap: CrushMap) -> None:
+    ``choose_args`` (crush.h -> crush_choose_arg; the balancer's knob)
+    are baked into the tables: per-bucket hash-id overrides become an
+    alternate id table, and per-position weight_set vectors become a
+    (bucket, position, slot) weight tensor indexed by the result
+    position (padded positions replicate each bucket's last vector,
+    matching bucket_straw2_choose's min(position, size-1) clamp).
+    """
+
+    def __init__(self, cmap: CrushMap,
+                 choose_args: Optional[Dict[int, "ChooseArg"]] = None
+                 ) -> None:
         for b in cmap.buckets.values():
             if b.alg != CRUSH_BUCKET_STRAW2:
                 raise ValueError(
                     "bulk evaluator supports straw2 maps; use the host "
                     f"mapper for bucket alg {b.alg}")
         self.cmap = cmap
+        self.choose_args = choose_args
         ids = sorted(cmap.buckets)          # negative ids
         self.n_buckets = len(ids)
         self.row_of_id = {bid: i for i, bid in enumerate(ids)}
         S = max((cmap.buckets[b].size for b in ids), default=1)
         self.max_size = S
+        P = 1
+        if choose_args:
+            P = max([1] + [len(a.weight_set) for a in choose_args.values()
+                           if a.weight_set])
+        self.n_positions = P
         items = np.full((self.n_buckets, S), NONE, np.int32)
-        weights = np.zeros((self.n_buckets, S), np.int64)
+        hash_ids = np.full((self.n_buckets, S), NONE, np.int32)
+        pos_weights = np.zeros((self.n_buckets, P, S), np.int64)
         types = np.zeros(self.n_buckets, np.int32)
         sizes = np.zeros(self.n_buckets, np.int32)
         for bid, row in self.row_of_id.items():
             b = cmap.buckets[bid]
             items[row, :b.size] = b.items
-            weights[row, :b.size] = b.item_weights
+            hash_ids[row, :b.size] = b.items
+            pos_weights[row, :, :b.size] = b.item_weights
             types[row] = b.type
             sizes[row] = b.size
+            arg = choose_args.get(bid) if choose_args else None
+            if arg is not None:
+                if arg.ids:
+                    hash_ids[row, :b.size] = arg.ids[:b.size]
+                if arg.weight_set:
+                    ws = arg.weight_set
+                    for p in range(P):
+                        pos_weights[row, p, :b.size] = \
+                            ws[min(p, len(ws) - 1)][:b.size]
         max_neg = max((-bid for bid in ids), default=0)
         i2r = np.full(max_neg + 1, 0, np.int32)
         for bid, row in self.row_of_id.items():
             i2r[-1 - bid] = row
         self.items = jnp.asarray(items)
-        self.weights = jnp.asarray(weights)
+        self.hash_ids = jnp.asarray(hash_ids)
+        self.pos_weights = jnp.asarray(pos_weights)
         self.types = jnp.asarray(types)
         self.sizes = jnp.asarray(sizes)
         self.id_to_row = jnp.asarray(i2r)
@@ -162,19 +191,27 @@ class CompiledCrushMap:
         return self.id_to_row[-1 - item]
 
 
-def _straw2(cm: CompiledCrushMap, row, x, r):
+def _straw2(cm: CompiledCrushMap, row, x, r, pos=0):
     """bucket_straw2_choose over table rows; broadcasts over any leading
-    shape of ``row``/``r`` (x scalar per lane).
+    shape of ``row``/``r``/``pos`` (x scalar per lane).
+
+    ``pos``: result position for the choose_args weight_set lookup
+    (mapper.c passes outpos; tables replicate each bucket's last vector
+    past its length, so one global clamp suffices).  Hashing uses the
+    per-bucket id table (choose_args ids override).
 
     draw = trunc((crush_ln(u) - 2^48) / w) = -(negln[u] // w); argmax
     with first-index-wins maps to argmax over (draw, -index) — jnp.argmax
     already returns the first maximal index."""
     items = cm.items[row]                      # (..., S)
-    weights = cm.weights[row]
+    hash_ids = cm.hash_ids[row]
+    pos_c = jnp.minimum(jnp.asarray(pos), cm.n_positions - 1)
+    pos_c = jnp.broadcast_to(pos_c, jnp.shape(row))
+    weights = cm.pos_weights[row, pos_c]       # (..., S)
     valid = jnp.arange(cm.max_size) < cm.sizes[row][..., None]
     u = crush_hash32_3(
         jnp.asarray(x, jnp.uint32),
-        items.astype(jnp.uint32),
+        hash_ids.astype(jnp.uint32),
         jnp.asarray(r, jnp.uint32)[..., None]).astype(jnp.int64) & 0xFFFF
     draw = jnp.where((weights > 0) & valid,
                      -(cm.negln[u] // jnp.maximum(weights, 1)), S64_MIN)
@@ -183,11 +220,11 @@ def _straw2(cm: CompiledCrushMap, row, x, r):
 
 
 def _descend(cm: CompiledCrushMap, start_item, x, r, target_type,
-             steps: Optional[int] = None):
+             steps: Optional[int] = None, pos=0):
     """Walk from start_item down to an item of target_type (mapper.c
     itemtype != type descent), statically unrolled ``steps`` times
     (regular hierarchies: exactly the level distance; else tree depth).
-    ``start_item``/``r`` may be vectors (attempt batches)."""
+    ``start_item``/``r``/``pos`` may be vectors (attempt batches)."""
     r = jnp.asarray(r)
     if steps is None:
         steps = cm.max_depth + 1
@@ -198,7 +235,7 @@ def _descend(cm: CompiledCrushMap, start_item, x, r, target_type,
         row = jnp.where(is_bucket, cm.row(item), 0)
         itype = jnp.where(is_bucket, cm.types[row], 0)
         arrived = itype == target_type
-        picked = _straw2(cm, row, x, r)
+        picked = _straw2(cm, row, x, r, pos)
         nxt = jnp.where(done | arrived | ~is_bucket, item, picked)
         done = done | arrived | (~is_bucket)
         item = nxt
@@ -220,16 +257,17 @@ def _is_out(weight_vec, item, x):
 
 
 def _candidates(cm, take, x, rs, type_, recurse_to_leaf, weight_vec,
-                take_type):
+                take_type, pos=0):
     """All candidate picks for an attempt grid ``rs`` in two batched
     descents: the heavy hash work for every (rep, try) is one fused
-    computation; only the cheap accept logic stays sequential."""
+    computation; only the cheap accept logic stays sequential.
+    ``pos``: choose_args position grid (mapper.c outpos; see callers)."""
     items, ok = _descend(cm, take, x, rs, type_,
-                         cm.descend_steps(take_type, type_))
+                         cm.descend_steps(take_type, type_), pos)
     if recurse_to_leaf:
         # stable=1 -> recursion rep 0; vary_r=1 -> sub_r = r >> 0
         leaves, lok = _descend(cm, items, x, rs, 0,
-                               cm.descend_steps(type_, 0))
+                               cm.descend_steps(type_, 0), pos)
         lout = _is_out(weight_vec, leaves, x)
         ok = ok & lok & ~lout
     else:
@@ -250,9 +288,14 @@ def _choose_firstn(cm, take, x, numrep, type_, recurse_to_leaf,
     tunables (no local retries).  Returns (out, count, need_host)."""
     rs = (jnp.arange(numrep, dtype=jnp.int64)[:, None]
           + jnp.arange(T, dtype=jnp.int64)[None, :])        # (R, T)
+    # choose_args position = outpos at bucket-choose time; bulk keeps
+    # only lanes where every rep places (a failed rep flags need_host),
+    # so outpos == rep for both the domain pick and the leaf recursion
+    # (firstn recursion passes the parent outpos through)
+    pos = jnp.arange(numrep)[:, None]                       # (R, 1)
     items, leaves, ok0 = _candidates(cm, take, x, rs, type_,
                                      recurse_to_leaf, weight_vec,
-                                     take_type)
+                                     take_type, pos)
     out = jnp.full(numrep, NONE, jnp.int32)
     out2 = jnp.full(numrep, NONE, jnp.int32)
     placed_n = jnp.int32(0)
@@ -283,14 +326,18 @@ def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
     straw2-only stride)."""
     rs = (jnp.arange(numrep, dtype=jnp.int64)[None, :]
           + numrep * jnp.arange(T, dtype=jnp.int64)[:, None])  # (T, R)
-    # leaf recursion parent_r = r, inner rep index = rep: r2 = rep + r
+    # leaf recursion parent_r = r, inner rep index = rep: r2 = rep + r.
+    # choose_args position: crush_choose_indep passes its own outpos
+    # (= 0 here, one choose per take) to the domain pick, and rep to
+    # the leaf recursion's bucket choose.
     items, ok0 = _descend(cm, take, x, rs, type_,
-                          cm.descend_steps(take_type, type_))
+                          cm.descend_steps(take_type, type_), 0)
     if recurse_to_leaf:
         leaves, lok = _descend(cm, items, x,
                                rs + jnp.arange(numrep,
                                                dtype=jnp.int64)[None, :],
-                               0, cm.descend_steps(type_, 0))
+                               0, cm.descend_steps(type_, 0),
+                               jnp.arange(numrep)[None, :])
         lout = _is_out(weight_vec, leaves, x)
         ok0 = ok0 & lok & ~lout
     else:
@@ -427,7 +474,8 @@ FIRST_PASS_TRIES = 2  # covers the no-collision common case
 def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
                  weight: Optional[Sequence[int]] = None,
                  bulk_tries: int = DEFAULT_BULK_TRIES,
-                 return_stats: bool = False):
+                 return_stats: bool = False,
+                 choose_args: Optional[Dict[int, "ChooseArg"]] = None):
     """Evaluate a rule for many inputs at once on device; bit-identical
     to the host mapper.
 
@@ -441,7 +489,16 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
     Returns (results (N, result_max) int32 with CRUSH_ITEM_NONE holes,
     counts (N,)); with return_stats also the host-fallback lane count.
     """
-    cm = cmap if isinstance(cmap, CompiledCrushMap) else CompiledCrushMap(cmap)
+    if isinstance(cmap, CompiledCrushMap):
+        cm = cmap
+        if choose_args is not None and cm.choose_args is not choose_args:
+            raise ValueError(
+                "choose_args differ from the ones this CompiledCrushMap "
+                "was built with; rebuild CompiledCrushMap(cmap, "
+                "choose_args)")
+        choose_args = cm.choose_args
+    else:
+        cm = CompiledCrushMap(cmap, choose_args)
     if weight is None:
         weight = cm.cmap.device_weights()
     wv = jnp.asarray(np.asarray(weight, dtype=np.int64))
@@ -464,7 +521,7 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
     n_fallback = int(redo.size)
     for i in redo:
         r = crush_do_rule(cm.cmap, ruleno, int(xs[i]), result_max,
-                          weight=list(weight))
+                          weight=list(weight), choose_args=choose_args)
         out[i] = r + [NONE] * (result_max - len(r))
         cnt[i] = len(r)
     if return_stats:
