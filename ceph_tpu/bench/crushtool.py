@@ -3,12 +3,17 @@
 Supported surface (the --test path is the north-star bulk-remap metric,
 SURVEY.md §6 row 5):
 
-  python -m ceph_tpu.bench.crushtool -i map.json --test \\
+  python -m ceph_tpu.bench.crushtool -i map.txt --test \\
       --rule 0 --num-rep 3 --min-x 0 --max-x 999999 \\
       --show-statistics [--show-mappings] [--engine bulk|host] \\
-      [--weight DEV W]...
-  python -m ceph_tpu.bench.crushtool --build-two-level H D -o map.json
-  python -m ceph_tpu.bench.crushtool -d map.json      (decompile: print)
+      [--choose-args NAME] [--weight DEV W]...
+  python -m ceph_tpu.bench.crushtool --build-two-level H D -o map.txt
+  python -m ceph_tpu.bench.crushtool -d map.txt       (decompile: print)
+
+Maps are read in either interchange form — the crushtool text grammar
+(the format `crushtool -d` emits from live clusters; auto-detected) or
+this framework's JSON (first non-space byte '{').  -o writes text by
+default, JSON when the filename ends in .json.
 
 Output format follows crushtool --test --show-statistics: per-device
 placement counts plus a mappings/s line (the benchmark figure).
@@ -22,16 +27,33 @@ import sys
 from ..crush.builder import CrushBuilder
 from ..crush.compiler import compile_map, decompile
 from ..crush.tester import test_rule
+from ..crush.text_compiler import compile_text, decompile_text
 from ..crush.types import CRUSH_ITEM_NONE
+
+
+def read_map(path: str):
+    """Auto-detect interchange form: JSON ('{' first) or crushtool
+    text grammar."""
+    text = open(path).read()
+    if text.lstrip().startswith("{"):
+        return compile_map(text)
+    return compile_text(text)
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="crushtool",
                                 description=__doc__.split("\n")[0])
-    p.add_argument("-i", "--infn", help="input map (JSON)")
-    p.add_argument("-o", "--outfn", help="output map (JSON)")
+    p.add_argument("-i", "--infn",
+                   help="input map (crushtool text or JSON, auto)")
+    p.add_argument("-o", "--outfn",
+                   help="output map (text; JSON for .json suffix)")
     p.add_argument("-d", "--decompile", metavar="MAP",
-                   help="print the JSON text of MAP")
+                   help="print the crushtool text form of MAP")
+    p.add_argument("--format", choices=("text", "json"),
+                   help="output form for -d/-o (default: text, or by "
+                        "-o suffix)")
+    p.add_argument("--choose-args", metavar="NAME",
+                   help="apply the named choose_args set during --test")
     p.add_argument("--build-two-level", nargs=2, type=int,
                    metavar=("HOSTS", "DEVS"),
                    help="build a root->host->osd straw2 map")
@@ -50,13 +72,14 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.decompile:
-        cmap = compile_map(open(args.decompile).read())
-        print(decompile(cmap))
+        cmap = read_map(args.decompile)
+        print(decompile(cmap) if args.format == "json"
+              else decompile_text(cmap), end="")
         return 0
 
     cmap = None
     if args.infn:
-        cmap = compile_map(open(args.infn).read())
+        cmap = read_map(args.infn)
     elif args.build_two_level:
         h, d = args.build_two_level
         b = CrushBuilder()
@@ -68,17 +91,27 @@ def main(argv=None) -> int:
         p.error("need -i MAP or --build-two-level")
 
     if args.outfn:
+        as_json = (args.format == "json"
+                   or (args.format is None
+                       and args.outfn.endswith(".json")))
         with open(args.outfn, "w") as f:
-            f.write(decompile(cmap))
+            f.write(decompile(cmap) if as_json else decompile_text(cmap))
         print(f"wrote {args.outfn}", file=sys.stderr)
 
     if args.test:
         weight = cmap.device_weights()
         for dev, w in args.weight:
             weight[int(dev)] = int(float(w) * 0x10000)
+        choose_args = None
+        if args.choose_args is not None:
+            choose_args = cmap.choose_args.get(args.choose_args)
+            if choose_args is None:
+                p.error(f"map has no choose_args set "
+                        f"{args.choose_args!r}")
         res = test_rule(cmap, args.rule, args.num_rep, args.min_x,
                         args.max_x, weight=weight, engine=args.engine,
-                        keep_mappings=args.show_mappings)
+                        keep_mappings=args.show_mappings,
+                        choose_args=choose_args)
         if args.show_mappings:
             for i, row in enumerate(res.mappings):
                 devs = [int(d) for d in row if d != CRUSH_ITEM_NONE]

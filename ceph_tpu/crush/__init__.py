@@ -13,6 +13,9 @@ mapper.c, CrushWrapper.{h,cc}, CrushTester.{h,cc}):
 - ``bulk``    — the TPU-native bulk evaluator: straw2 hierarchies
   evaluated for millions of inputs at once via vmapped jax.
 - ``tester``  — CrushTester-style mapping sweeps + statistics.
+- ``compiler`` / ``text_compiler`` — JSON and crushtool-text-grammar
+  compile/decompile (CrushCompiler role); real cluster maps decompiled
+  by crushtool drive the evaluators directly.
 """
 
 from .types import (  # noqa: F401
@@ -30,3 +33,5 @@ from .types import (  # noqa: F401
 )
 from .builder import CrushBuilder  # noqa: F401
 from .mapper import crush_do_rule  # noqa: F401
+from .compiler import compile_map, decompile  # noqa: F401
+from .text_compiler import compile_text, decompile_text  # noqa: F401

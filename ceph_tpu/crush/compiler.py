@@ -67,6 +67,11 @@ def decompile(cmap: CrushMap) -> str:
             }
             for name, args in cmap.choose_args.items()
         },
+        "device_names": {str(d): n for d, n in cmap.item_names.items()
+                         if d >= 0},
+        "device_classes": {str(d): c
+                           for d, c in cmap.device_classes.items()},
+        "extra_tunables": dict(cmap.extra_tunables),
     }
     return json.dumps(doc, indent=2)
 
@@ -100,4 +105,9 @@ def compile_map(text: str) -> CrushMap:
                                 ids=ca.get("ids"))
             for bid, ca in args.items()
         }
+    for d, n in doc.get("device_names", {}).items():
+        cmap.item_names[int(d)] = n
+    cmap.device_classes = {int(d): c for d, c in
+                           doc.get("device_classes", {}).items()}
+    cmap.extra_tunables = dict(doc.get("extra_tunables", {}))
     return cmap

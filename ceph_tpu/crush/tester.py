@@ -55,7 +55,8 @@ def test_rule(cmap: CrushMap, ruleno: int, num_rep: int,
               min_x: int = 0, max_x: int = 1023,
               weight: Optional[Sequence[int]] = None,
               engine: str = "host",
-              keep_mappings: bool = False) -> TestResult:
+              keep_mappings: bool = False,
+              choose_args=None) -> TestResult:
     """CrushTester::test equivalent."""
     rules = cmap.cmap.rules if hasattr(cmap, "cmap") else cmap.rules
     if ruleno not in rules:
@@ -67,14 +68,16 @@ def test_rule(cmap: CrushMap, ruleno: int, num_rep: int,
     if engine == "bulk":
         from .bulk import CompiledCrushMap, bulk_do_rule
         cm = (cmap if isinstance(cmap, CompiledCrushMap)
-              else CompiledCrushMap(cmap))
+              else CompiledCrushMap(cmap, choose_args))
         xs = np.arange(min_x, max_x + 1)
         # untimed warm call: jit compilation is one-time per (map, rule,
         # batch shape) and must not pollute the mappings/s figure (the
         # encode bench warms up the same way)
-        bulk_do_rule(cm, ruleno, xs, num_rep, weight=weight)
+        bulk_do_rule(cm, ruleno, xs, num_rep, weight=weight,
+                     choose_args=choose_args)
         t0 = time.perf_counter()
-        out, cnt = bulk_do_rule(cm, ruleno, xs, num_rep, weight=weight)
+        out, cnt = bulk_do_rule(cm, ruleno, xs, num_rep, weight=weight,
+                                choose_args=choose_args)
         elapsed = time.perf_counter() - t0
         devs, dcnt = np.unique(out[out != CRUSH_ITEM_NONE],
                                return_counts=True)
@@ -86,7 +89,8 @@ def test_rule(cmap: CrushMap, ruleno: int, num_rep: int,
         mappings_list: List[List[int]] = []
         t0 = time.perf_counter()
         for x in range(min_x, max_x + 1):
-            r = crush_do_rule(cmap, ruleno, x, num_rep, weight=weight)
+            r = crush_do_rule(cmap, ruleno, x, num_rep, weight=weight,
+                              choose_args=choose_args)
             placed = [d for d in r if d != CRUSH_ITEM_NONE]
             for d in placed:
                 counts[d] = counts.get(d, 0) + 1

@@ -160,6 +160,13 @@ class CrushMap:
     item_names: Dict[int, str] = field(default_factory=dict)
     # choose_args: name -> {bucket_id -> ChooseArg}
     choose_args: Dict[str, Dict[int, ChooseArg]] = field(default_factory=dict)
+    # CrushWrapper class_map role: device id -> device class name
+    # (recorded for interchange; shadow trees are not built yet)
+    device_classes: Dict[int, str] = field(default_factory=dict)
+    # tunables carried by real maps that don't affect placement here
+    # (straw_calc_version, allowed_bucket_algs, ...) — preserved for
+    # round-trips
+    extra_tunables: Dict[str, int] = field(default_factory=dict)
 
     def bucket(self, item: int) -> Bucket:
         return self.buckets[item]

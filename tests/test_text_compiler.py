@@ -1,0 +1,318 @@
+"""crushtool text-grammar compile/decompile round-trips — the format
+real cluster maps arrive in (CrushCompiler::compile/decompile)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import CrushBuilder, Tunables, crush_do_rule
+from ceph_tpu.crush.text_compiler import compile_text, decompile_text
+from ceph_tpu.crush.types import (
+    ChooseArg,
+    step_choose_indep,
+    step_chooseleaf_firstn,
+    step_chooseleaf_indep,
+    step_emit,
+    step_set_choose_tries,
+    step_take,
+)
+
+# a realistic text map, written in crushtool -d's shape
+REAL_MAP = """\
+# begin crush map
+tunable choose_local_tries 0
+tunable choose_local_fallback_tries 0
+tunable choose_total_tries 50
+tunable chooseleaf_descend_once 1
+tunable chooseleaf_vary_r 1
+tunable chooseleaf_stable 1
+tunable straw_calc_version 1
+tunable allowed_bucket_algs 54
+
+# devices
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+device 4 osd.4
+device 5 osd.5
+
+# types
+type 0 osd
+type 1 host
+type 2 rack
+type 3 root
+
+# buckets
+host host-a {
+	id -2		# do not change unnecessarily
+	# weight 2.00000
+	alg straw2
+	hash 0	# rjenkins1
+	item osd.0 weight 1.00000
+	item osd.1 weight 1.00000
+}
+host host-b {
+	id -3
+	alg straw2
+	hash 0	# rjenkins1
+	item osd.2 weight 1.50000
+	item osd.3 weight 0.50000
+}
+host host-c {
+	id -5
+	alg straw2
+	hash 0	# rjenkins1
+	item osd.4 weight 1.00000
+	item osd.5 weight 1.00000
+}
+rack rack-1 {
+	id -6
+	alg straw2
+	hash 0	# rjenkins1
+	item host-a weight 2.00000
+	item host-b weight 2.00000
+}
+rack rack-2 {
+	id -7
+	alg straw2
+	hash 0	# rjenkins1
+	item host-c weight 2.00000
+}
+root default {
+	id -1
+	alg straw2
+	hash 0	# rjenkins1
+	item rack-1 weight 4.00000
+	item rack-2 weight 2.00000
+}
+
+# rules
+rule replicated_rule {
+	id 0
+	type replicated
+	min_size 1
+	max_size 10
+	step take default
+	step chooseleaf firstn 0 type host
+	step emit
+}
+rule ec_rule {
+	id 1
+	type erasure
+	min_size 3
+	max_size 6
+	step set_chooseleaf_tries 5
+	step set_choose_tries 100
+	step take default
+	step chooseleaf indep 0 type host
+	step emit
+}
+
+# choose_args
+choose_args 0 {
+  {
+    bucket_id -1
+    weight_set [
+      [ 4.00000 2.00000 ]
+      [ 3.50000 2.50000 ]
+    ]
+  }
+  {
+    bucket_id -2
+    weight_set [
+      [ 1.00000 1.00000 ]
+    ]
+    ids [ 1000 1001 ]
+  }
+}
+# end crush map
+"""
+
+
+def test_compile_real_map_drives_evaluators():
+    cmap = compile_text(REAL_MAP)
+    assert cmap.max_devices == 6
+    assert cmap.tunables.choose_total_tries == 50
+    assert cmap.extra_tunables["straw_calc_version"] == 1
+    assert cmap.item_names[-1] == "default"
+    assert cmap.buckets[-3].item_weights == [0x18000, 0x8000]
+    assert cmap.rules[1].type == 3 and cmap.rules[1].name == "ec_rule"
+    # the map drives the host mapper...
+    for x in range(100):
+        res = crush_do_rule(cmap, 0, x, 3)
+        assert len(res) == 3 and len(set(res)) == 3
+    # ...and the bulk evaluator, including its choose_args
+    bulk = pytest.importorskip("ceph_tpu.crush.bulk")
+    args = cmap.choose_args["0"]
+    out, cnt = bulk.bulk_do_rule(cmap, 0, np.arange(100), 3,
+                                 choose_args=args)
+    for x in range(100):
+        ref = crush_do_rule(cmap, 0, x, 3, choose_args=args)
+        assert list(out[x]) == ref, x
+
+
+def test_text_round_trip_exact():
+    """compile(decompile(M)) == M for every placement-relevant field."""
+    m1 = compile_text(REAL_MAP)
+    text = decompile_text(m1)
+    m2 = compile_text(text)
+    assert sorted(m1.buckets) == sorted(m2.buckets)
+    for bid in m1.buckets:
+        b1, b2 = m1.buckets[bid], m2.buckets[bid]
+        assert (b1.items, b1.item_weights, b1.alg, b1.type) == \
+            (b2.items, b2.item_weights, b2.alg, b2.type), bid
+    assert {r: m1.rules[r].steps for r in m1.rules} == \
+        {r: m2.rules[r].steps for r in m2.rules}
+    assert vars(m1.tunables) == vars(m2.tunables)
+    assert m1.extra_tunables == m2.extra_tunables
+    ca1, ca2 = m1.choose_args["0"], m2.choose_args["0"]
+    assert sorted(ca1) == sorted(ca2)
+    for bid in ca1:
+        assert ca1[bid].weight_set == ca2[bid].weight_set
+        assert ca1[bid].ids == ca2[bid].ids
+    # and identical mappings
+    for x in range(50):
+        assert crush_do_rule(m1, 0, x, 3) == crush_do_rule(m2, 0, x, 3)
+        assert crush_do_rule(m1, 1, x, 4) == crush_do_rule(m2, 1, x, 4)
+
+
+def test_builder_map_survives_text_round_trip():
+    """Maps built programmatically (all five bucket algs elsewhere;
+    straw2 here with every step kind) decompile to text and come back
+    placement-identical."""
+    b = CrushBuilder(Tunables(chooseleaf_stable=0))
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    hosts = [b.add_bucket("straw2", "host", [i * 2, i * 2 + 1],
+                          [0x10000 + i * 0x1234, 0x20000 - i * 0x777],
+                          name=f"h{i}")
+             for i in range(4)]
+    root = b.add_bucket("straw2", "root", hosts, name="root")
+    b.add_rule(0, [step_take(root), step_set_choose_tries(77),
+                   step_chooseleaf_firstn(0, 1), step_emit()],
+               name="r0")
+    b.add_rule(5, [step_take(root), step_choose_indep(2, 1),
+                   step_chooseleaf_indep(1, 0), step_emit()], name="r5")
+    b.map.choose_args["compat"] = {
+        root: ChooseArg(weight_set=[[0x8000] * 4, [0x18000] * 4])}
+    m2 = compile_text(decompile_text(b.map))
+    assert m2.tunables.chooseleaf_stable == 0
+    for x in range(80):
+        assert crush_do_rule(b.map, 0, x, 3) == crush_do_rule(m2, 0, x, 3)
+        assert crush_do_rule(b.map, 5, x, 2) == crush_do_rule(m2, 5, x, 2)
+    args1 = b.map.choose_args["compat"]
+    args2 = m2.choose_args["compat"]
+    for x in range(80):
+        assert (crush_do_rule(b.map, 0, x, 3, choose_args=args1)
+                == crush_do_rule(m2, 0, x, 3, choose_args=args2))
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError, match="undefined item"):
+        compile_text("type 0 osd\ntype 1 host\nhost h { id -1 alg straw2 "
+                     "hash 0 item osd.9 weight 1.0 }")
+    with pytest.raises(ValueError, match="shadow trees"):
+        compile_text(REAL_MAP.replace("step take default",
+                                      "step take default class hdd", 1))
+    with pytest.raises(ValueError, match="rjenkins1"):
+        compile_text("type 0 osd\ntype 1 host\ndevice 0 osd.0\n"
+                     "host h { id -1 alg straw2 hash 2 "
+                     "item osd.0 weight 1.0 }")
+    with pytest.raises(ValueError, match="unknown alg"):
+        compile_text("type 0 osd\ntype 1 host\ndevice 0 osd.0\n"
+                     "host h { id -1 alg bogus hash 0 "
+                     "item osd.0 weight 1.0 }")
+
+
+def test_device_classes_and_gaps_round_trip():
+    text = ("device 0 osd.0 class ssd\ndevice 1 osd.1 class hdd\n"
+            "device 2 osd.2\n"
+            "type 0 osd\ntype 1 host\n"
+            "host h0 { id -1 alg straw2 hash 0 "
+            "item osd.0 weight 1.0 item osd.1 weight 1.0 "
+            "item osd.2 weight 1.0 }\n")
+    m = compile_text(text)
+    assert m.device_classes == {0: "ssd", 1: "hdd"}
+    m2 = compile_text(decompile_text(m))
+    assert m2.device_classes == m.device_classes
+
+
+def test_crushtool_cli_text_roundtrip(tmp_path, capsys):
+    """crushtool CLI: text in, --test sweep, -d prints text, -o .json
+    writes JSON, --choose-args applies a named set."""
+    from ceph_tpu.bench.crushtool import main
+    mp = tmp_path / "map.txt"
+    mp.write_text(REAL_MAP)
+    assert main(["-i", str(mp), "--test", "--rule", "0", "--num-rep",
+                 "3", "--min-x", "0", "--max-x", "63", "--engine",
+                 "host", "--show-statistics"]) == 0
+    out = capsys.readouterr().out
+    assert "num_mappings 64" in out and "bad mappings: 0" in out
+    assert main(["-i", str(mp), "--test", "--rule", "0", "--num-rep",
+                 "3", "--max-x", "63", "--engine", "host",
+                 "--choose-args", "0"]) == 0
+    out2 = capsys.readouterr().out
+    assert "num_mappings 64" in out2
+    assert main(["-d", str(mp)]) == 0
+    text = capsys.readouterr().out
+    assert text.startswith("# begin crush map")
+    m2 = compile_text(text)
+    for x in range(30):
+        assert (crush_do_rule(m2, 0, x, 3)
+                == crush_do_rule(compile_text(REAL_MAP), 0, x, 3))
+    jp = tmp_path / "map.json"
+    assert main(["-i", str(mp), "-o", str(jp)]) == 0
+    assert jp.read_text().lstrip().startswith("{")
+
+
+def test_json_conversion_preserves_classes_names_tunables():
+    """text -> JSON -> map keeps device classes, device names, and
+    extra tunables (the two interchange forms are equivalent)."""
+    from ceph_tpu.crush.compiler import compile_map, decompile
+    m1 = compile_text(REAL_MAP.replace("device 1 osd.1",
+                                       "device 1 osd.1 class hdd"))
+    m2 = compile_map(decompile(m1))
+    assert m2.device_classes == {1: "hdd"}
+    assert m2.extra_tunables == m1.extra_tunables
+    assert m2.item_names[0] == "osd.0"
+    # and back out to text identically
+    assert decompile_text(m2) == decompile_text(m1)
+
+
+def test_device_id_holes_not_fabricated():
+    """Maps with device-id holes (post-OSD-removal shape) must not gain
+    phantom device lines on decompile."""
+    text = ("device 0 osd.0\ndevice 5 osd.5\n"
+            "type 0 osd\ntype 1 host\n"
+            "host h0 { id -1 alg straw2 hash 0 "
+            "item osd.0 weight 1.0 item osd.5 weight 1.0 }\n")
+    m = compile_text(text)
+    assert m.max_devices == 6
+    out = decompile_text(m)
+    dev_lines = [ln for ln in out.splitlines() if ln.startswith("device ")]
+    assert dev_lines == ["device 0 osd.0", "device 5 osd.5"]
+
+
+def test_unsupported_rule_type_clear_error():
+    bad = REAL_MAP.replace("type erasure", "type msr_indep", 1)
+    with pytest.raises(ValueError, match="unsupported rule type"):
+        compile_text(bad)
+
+
+def test_tester_forwards_choose_args_to_bulk():
+    """test_rule(engine='bulk') must apply choose_args (and reject a
+    mismatched pre-compiled map via bulk's guard)."""
+    from ceph_tpu.crush.tester import test_rule
+    cmap = compile_text(REAL_MAP)
+    args = cmap.choose_args["0"]
+    host = test_rule(cmap, 0, 3, 0, 99, engine="host",
+                     keep_mappings=True, choose_args=args)
+    bulk_res = test_rule(cmap, 0, 3, 0, 99, engine="bulk",
+                         keep_mappings=True, choose_args=args)
+    assert np.array_equal(host.mappings, bulk_res.mappings)
+    base = test_rule(cmap, 0, 3, 0, 99, engine="bulk", keep_mappings=True)
+    assert not np.array_equal(base.mappings, bulk_res.mappings)
+    from ceph_tpu.crush.bulk import CompiledCrushMap
+    cm = CompiledCrushMap(cmap)  # compiled WITHOUT choose_args
+    with pytest.raises(ValueError, match="choose_args differ"):
+        test_rule(cm, 0, 3, 0, 9, engine="bulk", choose_args=args)
