@@ -92,6 +92,11 @@ class ErasureCodeBench:
                              "when the bench host reaches the chip over a "
                              "high-latency tunnel")
         ap.add_argument("--json", action="store_true", dest="json_out")
+        ap.add_argument("--dump-perf", action="store_true",
+                        help="print the perf-counter registry (perf "
+                             "dump role) to stderr after the run")
+        ap.add_argument("--profile-dir", default=None,
+                        help="record a jax.profiler device trace here")
         ap.add_argument("--seed", type=int, default=42)
         self.args = ap.parse_args(argv)
         if self.args.iterations < 1:
@@ -320,6 +325,16 @@ class ErasureCodeBench:
         }
 
     def run(self) -> dict:
+        from ..utils.perf import global_perf, profile_trace
+        with profile_trace(self.args.profile_dir):
+            res = self._run_workload()
+        if self.args.dump_perf:
+            import json as _json
+            import sys as _sys
+            print(_json.dumps(global_perf().dump()), file=_sys.stderr)
+        return res
+
+    def _run_workload(self) -> dict:
         if self.args.workload == "encode":
             return self.encode()
         return self.decode()

@@ -24,6 +24,8 @@ import numpy as np
 
 from ..ops import regionops
 from ..ops.pallas_gf import apply_matrix_best
+from ..utils.debug import DeviceVerificationError, verification_enabled
+from ..utils.perf import global_perf
 from ..ops.xla_ops import (
     apply_bitmatrix_xla,
     apply_matrix_xla,
@@ -52,11 +54,25 @@ class MatrixCodeMixin:
 
     def _apply(self, chunks: np.ndarray, matrix: np.ndarray,
                matrix_static) -> np.ndarray:
+        perf = global_perf()
         words = regionops.words_view(np.ascontiguousarray(chunks), self.w)
         if chunks.nbytes < self.min_xla_bytes:
+            perf.inc("ec_host_calls")
+            perf.inc("ec_host_bytes", chunks.nbytes)
             return regionops.matrix_encode(words, matrix, self.w).view(np.uint8)
-        return np.asarray(
-            apply_matrix_best(words, matrix_static, self.w)).view(np.uint8)
+        perf.inc("ec_device_calls")
+        perf.inc("ec_device_bytes", chunks.nbytes)
+        with perf.timed("ec_device_time"):
+            out = np.asarray(
+                apply_matrix_best(words, matrix_static, self.w)).view(np.uint8)
+        if verification_enabled():
+            ref = regionops.matrix_encode(words, matrix,
+                                          self.w).view(np.uint8)
+            if not np.array_equal(out, ref):
+                raise DeviceVerificationError(
+                    "device matrix path diverged from host ground truth "
+                    f"(w={self.w}, shape={chunks.shape})")
+        return out
 
     def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
         return self._apply(data, self.matrix, self._matrix_static)
@@ -116,11 +132,25 @@ class BitmatrixCodeMixin:
 
     def _apply(self, chunks: np.ndarray, bitmatrix: np.ndarray,
                bitmatrix_static) -> np.ndarray:
+        perf = global_perf()
         if chunks.nbytes < self.min_xla_bytes:
+            perf.inc("ec_host_calls")
+            perf.inc("ec_host_bytes", chunks.nbytes)
             return regionops.bitmatrix_encode(chunks, bitmatrix, self.w,
                                               self.packetsize)
-        return np.asarray(apply_bitmatrix_xla(
-            chunks, bitmatrix_static, self.w, self.packetsize))
+        perf.inc("ec_device_calls")
+        perf.inc("ec_device_bytes", chunks.nbytes)
+        with perf.timed("ec_device_time"):
+            out = np.asarray(apply_bitmatrix_xla(
+                chunks, bitmatrix_static, self.w, self.packetsize))
+        if verification_enabled():
+            ref = regionops.bitmatrix_encode(chunks, bitmatrix, self.w,
+                                             self.packetsize)
+            if not np.array_equal(out, ref):
+                raise DeviceVerificationError(
+                    "device bitmatrix path diverged from host ground "
+                    f"truth (w={self.w}, shape={chunks.shape})")
+        return out
 
     def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
         return self._apply(np.ascontiguousarray(data), self.bitmatrix,

@@ -524,6 +524,18 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
                           weight=list(weight), choose_args=choose_args)
         out[i] = r + [NONE] * (result_max - len(r))
         cnt[i] = len(r)
+    from ..utils.debug import DeviceVerificationError, verification_enabled
+    if verification_enabled():
+        # sanitizer mode: every lane re-evaluated on the host oracle
+        for i in range(len(xs)):
+            r = crush_do_rule(cm.cmap, ruleno, int(xs[i]), result_max,
+                              weight=list(weight),
+                              choose_args=choose_args)
+            r = r + [NONE] * (result_max - len(r))
+            if list(out[i]) != r:
+                raise DeviceVerificationError(
+                    f"bulk evaluator diverged from host mapper at "
+                    f"x={int(xs[i])}: {list(out[i])} != {r}")
     if return_stats:
         return out, cnt, n_fallback
     return out, cnt

@@ -104,6 +104,10 @@ def test_rule(cmap: CrushMap, ruleno: int, num_rep: int,
                     if keep_mappings else None)
     else:
         raise ValueError(f"unknown engine {engine!r}")
+    from ..utils.perf import global_perf
+    perf = global_perf()
+    perf.inc(f"crush_mappings_{engine}", n)
+    perf.tinc(f"crush_test_time_{engine}", elapsed)
     return TestResult(num_mappings=n, num_rep=num_rep,
                       device_counts=counts, bad_mappings=bad,
                       elapsed_s=elapsed, engine=engine, mappings=mappings)

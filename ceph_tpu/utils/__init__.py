@@ -1,0 +1,12 @@
+"""ceph_tpu.utils — observability & debug surfaces.
+
+- ``perf``  — perf-counter registry (src/common/perf_counters.{h,cc}
+  role) + jax.profiler trace hook (the LTTng/`ceph daemon X perf dump`
+  analog, SURVEY.md §5 tracing row).
+- ``debug`` — sanitizer-equivalent switches (SURVEY.md §5 race/
+  sanitizer row): jax debug_nans/checkify-style verification mode for
+  the compute paths.
+"""
+
+from .perf import PerfCounters, global_perf, profile_trace  # noqa: F401
+from .debug import debug_mode, verification_enabled  # noqa: F401

@@ -1,0 +1,53 @@
+"""Sanitizer-equivalent debug mode — SURVEY.md §5 race/sanitizer row.
+
+The reference builds with WITH_ASAN/TSAN/UBSAN and runs valgrind in QA;
+the memory-safety half is Python/XLA's problem here, so the TPU-native
+analog is *semantic* sanitizing:
+
+- ``debug_mode()``: a context manager that turns on jax's NaN debugging
+  (jax_debug_nans — relevant to any float path, e.g. straw legacy
+  scaling) and runtime verification of the device compute paths.
+- verification: while enabled, every batched device encode/decode in
+  MatrixCodeMixin/BitmatrixCodeMixin is re-computed on the numpy host
+  ground truth and byte-compared (the "deterministic-kernel assertion":
+  XLA/Pallas results must be bit-identical to the reference region
+  ops), and the bulk CRUSH evaluator cross-checks every lane against
+  the host mapper.  A mismatch raises ``DeviceVerificationError``
+  at the call site instead of corrupting stored parity silently.
+
+Enable globally with CEPH_TPU_VERIFY=1 (the WITH_ASAN build-flag
+analog) or locally with ``with debug_mode(): ...``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+_ACTIVE = 0
+
+
+class DeviceVerificationError(AssertionError):
+    """Device compute path disagreed with the host ground truth."""
+
+
+def verification_enabled() -> bool:
+    return _ACTIVE > 0 or os.environ.get("CEPH_TPU_VERIFY") == "1"
+
+
+@contextlib.contextmanager
+def debug_mode(nan_checks: bool = True):
+    """Enable sanitizer-equivalent checking for the enclosed block."""
+    global _ACTIVE
+    import jax
+    prev_nan = None
+    if nan_checks:
+        prev_nan = jax.config.read("jax_debug_nans")
+        jax.config.update("jax_debug_nans", True)
+    _ACTIVE += 1
+    try:
+        yield
+    finally:
+        _ACTIVE -= 1
+        if nan_checks and prev_nan is not None:
+            jax.config.update("jax_debug_nans", prev_nan)

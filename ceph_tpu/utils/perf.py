@@ -1,0 +1,93 @@
+"""Perf counters — src/common/perf_counters.{h,cc} role.
+
+The reference exposes per-daemon counters (u64 increments, averages
+with count+sum, longest-running time tracking) through the admin
+socket (`ceph daemon X perf dump`, src/common/admin_socket.cc).  Here
+the registry is in-process: compute paths and benchmarks increment
+named counters, and ``dump()`` returns the JSON-shaped dict the
+reference's `perf dump` emits — the benchmark CLIs print it with
+``--dump-perf``.
+
+TPU tracing analog (SURVEY.md §5): ``profile_trace(dir)`` wraps
+``jax.profiler.trace`` so a benchmark run drops a TensorBoard-readable
+device trace next to its counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+
+class PerfCounters:
+    """Named counters: u64 ``inc``, time-average ``tinc`` (count + sum
+    seconds, like the reference's PERFCOUNTER_TIME|PERFCOUNTER_LONGRUNAVG
+    pairs), gauges via ``set``."""
+
+    def __init__(self, name: str = "ceph_tpu") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._u64: Dict[str, int] = {}
+        self._time: Dict[str, list] = {}   # name -> [count, sum_seconds]
+        self._gauge: Dict[str, float] = {}
+
+    def inc(self, counter: str, v: int = 1) -> None:
+        with self._lock:
+            self._u64[counter] = self._u64.get(counter, 0) + v
+
+    def tinc(self, counter: str, seconds: float) -> None:
+        with self._lock:
+            entry = self._time.setdefault(counter, [0, 0.0])
+            entry[0] += 1
+            entry[1] += seconds
+
+    def set_gauge(self, counter: str, v: float) -> None:
+        with self._lock:
+            self._gauge[counter] = v
+
+    @contextlib.contextmanager
+    def timed(self, counter: str):
+        """Time a block into a ``tinc`` pair."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.tinc(counter, time.perf_counter() - t0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._u64.clear()
+            self._time.clear()
+            self._gauge.clear()
+
+    def dump(self) -> dict:
+        """`ceph daemon X perf dump` shape: {registry: {counter: value
+        | {avgcount, sum}}}."""
+        with self._lock:
+            out: Dict[str, object] = dict(self._u64)
+            for k, (n, s) in self._time.items():
+                out[k] = {"avgcount": n, "sum": s}
+            out.update(self._gauge)
+            return {self.name: out}
+
+
+_GLOBAL = PerfCounters()
+
+
+def global_perf() -> PerfCounters:
+    """The process-wide registry (the per-CephContext singleton role)."""
+    return _GLOBAL
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]):
+    """jax.profiler.trace wrapper: no-op when ``log_dir`` is falsy (or
+    jax has no profiler), else records a device trace under log_dir."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,128 @@
+"""Perf counters (perf dump role) + sanitizer-equivalent debug mode."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from ceph_tpu.utils import PerfCounters, debug_mode, global_perf
+from ceph_tpu.utils.debug import DeviceVerificationError
+
+
+def test_perf_counters_shapes():
+    p = PerfCounters("t")
+    p.inc("calls")
+    p.inc("calls", 2)
+    p.inc("bytes", 4096)
+    p.tinc("time", 0.5)
+    p.tinc("time", 1.5)
+    p.set_gauge("gauge", 3.25)
+    d = p.dump()
+    assert d == {"t": {"calls": 3, "bytes": 4096,
+                       "time": {"avgcount": 2, "sum": 2.0},
+                       "gauge": 3.25}}
+    p.reset()
+    assert p.dump() == {"t": {}}
+
+
+def test_perf_counters_threaded():
+    p = PerfCounters()
+    def worker():
+        for _ in range(1000):
+            p.inc("n")
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert p.dump()["ceph_tpu"]["n"] == 8000
+
+
+def test_timed_context():
+    p = PerfCounters()
+    with p.timed("block"):
+        pass
+    d = p.dump()["ceph_tpu"]["block"]
+    assert d["avgcount"] == 1 and d["sum"] >= 0
+
+
+def test_compute_paths_count():
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    global_perf().reset()
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    data = np.random.default_rng(0).integers(0, 256, (2, 4, 4096),
+                                             dtype=np.uint8)
+    ec.encode_chunks_batch(data)                       # host (small)
+    big = np.random.default_rng(0).integers(
+        0, 256, (2, 4, 1 << 18), dtype=np.uint8)
+    ec.encode_chunks_batch(big)                        # device path
+    d = global_perf().dump()["ceph_tpu"]
+    assert d["ec_host_calls"] >= 1
+    assert d["ec_device_calls"] >= 1
+    assert d["ec_device_time"]["avgcount"] >= 1
+
+
+def test_debug_mode_verifies_device_path(monkeypatch):
+    """Under debug_mode, a corrupted device result raises instead of
+    returning silently wrong parity."""
+    from ceph_tpu.codes import techniques
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    big = np.random.default_rng(1).integers(
+        0, 256, (2, 4, 1 << 18), dtype=np.uint8)
+    with debug_mode(nan_checks=False):
+        ec.encode_chunks_batch(big)  # clean path passes verification
+    real = techniques.apply_matrix_best
+
+    def corrupt(words, static, w):
+        out = np.array(real(words, static, w))
+        out.flat[0] ^= 0xFF
+        return out
+
+    monkeypatch.setattr(techniques, "apply_matrix_best", corrupt)
+    with debug_mode(nan_checks=False):
+        with pytest.raises(DeviceVerificationError, match="diverged"):
+            ec.encode_chunks_batch(big)
+    # outside debug mode the corruption is NOT checked (fast path)
+    ec.encode_chunks_batch(big)
+
+
+def test_debug_mode_verifies_bulk_lanes(monkeypatch):
+    from ceph_tpu.crush import CrushBuilder, bulk as _  # noqa: F401
+    from ceph_tpu.crush import bulk
+    b = CrushBuilder()
+    root = b.build_two_level(3, 2)
+    b.add_simple_rule(0, root, "host")
+    with debug_mode(nan_checks=False):
+        bulk.bulk_do_rule(b.map, 0, np.arange(32), 2)  # clean: passes
+
+    real = bulk.crush_do_rule
+
+    def wrong(cmap, ruleno, x, result_max, **kw):
+        return [0] * result_max
+
+    monkeypatch.setattr(bulk, "crush_do_rule", wrong)
+    with debug_mode(nan_checks=False):
+        with pytest.raises(DeviceVerificationError, match="diverged"):
+            bulk.bulk_do_rule(b.map, 0, np.arange(32), 2)
+    monkeypatch.setattr(bulk, "crush_do_rule", real)
+
+
+def test_env_var_enables_verification(monkeypatch):
+    from ceph_tpu.utils.debug import verification_enabled
+    assert not verification_enabled()
+    monkeypatch.setenv("CEPH_TPU_VERIFY", "1")
+    assert verification_enabled()
+
+
+def test_bench_dump_perf(capsys):
+    from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+    bench = ErasureCodeBench()
+    bench.setup(["--parameter", "k=4", "--parameter", "m=2",
+                 "--size", "4096", "--iterations", "1",
+                 "--device", "host", "--dump-perf"])
+    bench.run()
+    err = capsys.readouterr().err
+    perf = json.loads(err.strip().splitlines()[-1])
+    assert "ceph_tpu" in perf
