@@ -192,6 +192,196 @@ class CrushBuilder:
         return self.add_rule(rule_id, [step_take(root), choose,
                                        step_emit()], name=name)
 
+    # -- device classes / shadow trees (CrushWrapper::populate_classes) -----
+
+    def set_item_class(self, device: int, class_name: str) -> None:
+        """CrushWrapper::set_item_class (devices only here)."""
+        if device < 0:
+            raise ValueError("classes attach to devices, not buckets")
+        self.map.device_classes[device] = class_name
+
+    def populate_classes(self) -> None:
+        """Build per-class shadow trees (CrushWrapper::populate_classes
+        -> device_class_clone): for every class and every bucket whose
+        subtree contains a device of that class, create a clone holding
+        only that class's items, with recomputed weights and fresh
+        negative ids.  `step take <bucket> class <c>` then resolves to
+        the clone via map.class_bucket.  Idempotent: existing shadows
+        are rebuilt in place (same ids) so weight edits propagate."""
+        cmap = self.map
+        # include classes that only exist as stale shadows (their last
+        # device was removed/re-classed): clone() sweeps them away
+        classes = sorted(set(cmap.device_classes.values())
+                         | {cls for (_, cls) in cmap.class_bucket})
+        shadow_ids = set(cmap.class_bucket.values())
+        originals = [bid for bid in sorted(cmap.buckets, reverse=True)
+                     if bid not in shadow_ids]
+        # shadow ids are placement-relevant (choosing among buckets
+        # hashes the item ids, which at interior levels ARE the shadow
+        # ids) — honor ids pinned by a parsed map ("id -N class C"
+        # lines) and allocate fresh ones below everything else
+        floor = min([0] + list(cmap.buckets)
+                    + list(cmap.class_bucket.values()))
+        next_free = [floor - 1]
+
+        def clone(bid: int, cls: str) -> Optional[int]:
+            b = cmap.buckets[bid]
+            items: List[int] = []
+            weights: List[int] = []
+            for it, w in zip(b.items, b.item_weights):
+                if it >= 0:
+                    if cmap.device_classes.get(it) == cls:
+                        items.append(it)
+                        weights.append(w)
+                else:
+                    sub = cmap.class_bucket.get((it, cls))
+                    if sub is not None and sub in cmap.buckets:
+                        items.append(sub)
+                        weights.append(cmap.buckets[sub].weight)
+            if not items:
+                # class died out of this subtree: drop any stale shadow
+                stale = cmap.class_bucket.pop((bid, cls), None)
+                if stale is not None:
+                    cmap.buckets.pop(stale, None)
+                    cmap.item_names.pop(stale, None)
+                return None
+            sid = cmap.class_bucket.get((bid, cls))
+            if sid is None:
+                sid = next_free[0]
+                next_free[0] -= 1
+            else:
+                cmap.buckets.pop(sid, None)  # rebuild in place, same id
+            sid = self.add_bucket(b.alg, b.type, items, weights,
+                                  bucket_id=sid)
+            cmap.class_bucket[(bid, cls)] = sid
+            name = cmap.item_names.get(bid)
+            if name:
+                cmap.item_names[sid] = f"{name}~{cls}"
+            return sid
+
+        # children before parents (originals sorted by id descending is
+        # not a topological order in general; recurse instead)
+        done = set()
+
+        def build(bid: int, cls: str) -> None:
+            if (bid, cls) in done:
+                return
+            done.add((bid, cls))
+            for it in cmap.buckets[bid].items:
+                if it < 0 and it not in shadow_ids:
+                    build(it, cls)
+            clone(bid, cls)
+
+        for cls in classes:
+            for bid in originals:
+                build(bid, cls)
+
+    def get_shadow(self, bucket_id: int, class_name: str) -> int:
+        """Shadow bucket id for `take <bucket> class <class>`."""
+        sid = self.map.class_bucket.get((bucket_id, class_name))
+        if sid is None or sid not in self.map.buckets:
+            raise ValueError(
+                f"no class {class_name!r} shadow for bucket {bucket_id} "
+                "(no such class, no class device under the bucket, or "
+                "populate_classes() not run)")
+        return sid
+
+    # -- weight editing (CrushWrapper::adjust_item_weight & co.) ------------
+
+    def _parents_of(self, item: int) -> List[int]:
+        """Primary buckets containing ``item`` (shadow clones are
+        derived state: the edit APIs touch originals and regenerate
+        shadows via populate_classes)."""
+        shadow_ids = set(self.map.class_bucket.values())
+        return [bid for bid, b in self.map.buckets.items()
+                if item in b.items and bid not in shadow_ids]
+
+    def _rebuild_aux(self, bucket: Bucket) -> None:
+        bucket.weight = sum(bucket.item_weights)
+        if bucket.alg == CRUSH_BUCKET_LIST:
+            bucket.sum_weights = make_list_aux(bucket.item_weights)
+        elif bucket.alg == CRUSH_BUCKET_TREE:
+            bucket.node_weights, bucket.num_nodes = make_tree_aux(
+                bucket.item_weights)
+        elif bucket.alg == CRUSH_BUCKET_STRAW:
+            bucket.straws = make_straws(bucket.item_weights)
+
+    def adjust_item_weight(self, item: int, weight: int) -> int:
+        """CrushWrapper::adjust_item_weight: set ``item``'s weight in
+        every bucket containing it and propagate the delta to all
+        ancestors (aux arrays rebuilt).  Returns the number of buckets
+        changed.  Rebuilds shadow trees when present."""
+        changed = 0
+        for bid in self._parents_of(item):
+            b = self.map.buckets[bid]
+            i = b.items.index(item)
+            if b.alg == CRUSH_BUCKET_UNIFORM and len(set(
+                    b.item_weights[:i] + [weight]
+                    + b.item_weights[i + 1:])) > 1:
+                raise ValueError("uniform bucket requires equal weights")
+            b.item_weights[i] = int(weight)
+            self._rebuild_aux(b)
+            changed += 1
+            self._propagate_weight(bid)
+        if changed and self.map.class_bucket:
+            self.populate_classes()
+        return changed
+
+    def _propagate_weight(self, bucket_id: int) -> None:
+        for pid in self._parents_of(bucket_id):
+            p = self.map.buckets[pid]
+            i = p.items.index(bucket_id)
+            p.item_weights[i] = self.map.buckets[bucket_id].weight
+            self._rebuild_aux(p)
+            self._propagate_weight(pid)
+
+    def insert_item(self, device: int, weight: int, bucket_id: int,
+                    name: Optional[str] = None,
+                    class_name: Optional[str] = None) -> None:
+        """CrushWrapper::insert_item (flat form: into one bucket)."""
+        b = self.map.buckets[bucket_id]
+        if device in b.items:
+            raise ValueError(f"item {device} already in {bucket_id}")
+        b.items.append(int(device))
+        b.item_weights.append(int(weight))
+        self._rebuild_aux(b)
+        self._propagate_weight(bucket_id)
+        if device >= 0:
+            self.map.max_devices = max(self.map.max_devices, device + 1)
+        if name:
+            self.map.item_names[device] = name
+        if class_name:
+            self.map.device_classes[device] = class_name
+        if self.map.class_bucket:
+            self.populate_classes()
+
+    def remove_item(self, item: int) -> int:
+        """CrushWrapper::remove_item: drop from every containing
+        bucket; returns the number of buckets changed.  Removing a
+        non-empty bucket is refused (upstream returns -ENOTEMPTY);
+        removing an empty bucket also deletes its node."""
+        if item < 0 and self.map.buckets.get(item) is not None \
+                and self.map.buckets[item].items:
+            raise ValueError(
+                f"bucket {item} is not empty (ENOTEMPTY); remove or "
+                "move its items first")
+        changed = 0
+        for bid in self._parents_of(item):
+            b = self.map.buckets[bid]
+            i = b.items.index(item)
+            del b.items[i]
+            del b.item_weights[i]
+            self._rebuild_aux(b)
+            self._propagate_weight(bid)
+            changed += 1
+        if item < 0:
+            self.map.buckets.pop(item, None)
+            self.map.item_names.pop(item, None)
+        self.map.device_classes.pop(item, None)
+        if changed and self.map.class_bucket:
+            self.populate_classes()
+        return changed
+
     # -- convenience: whole trees -------------------------------------------
 
     def build_flat(self, n_devices: int, alg="straw2",

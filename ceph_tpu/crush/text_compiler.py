@@ -40,9 +40,11 @@ Weights are decimal (16.16 fixed point / 0x10000) with 5 digits — one
 digit finer than the fixed-point ULP, so text round-trips are exact.
 Unknown `tunable` names parse and re-emit verbatim (real maps carry
 straw_calc_version / allowed_bucket_algs, which don't affect straw2
-placement).  Device classes are recognized on `device` lines and
-re-emitted; class-filtered `step take ... class ...` needs the shadow
-trees CrushWrapper builds and is rejected with a clear error.
+placement).  Device classes on `device` lines feed CrushWrapper-style
+shadow trees (builder.py -> populate_classes): a class-filtered
+`step take <bucket> class <cls>` compiles to a take of the per-class
+shadow clone, and decompiling hides the shadows again, emitting the
+original `take ... class ...` form like crushtool does.
 
 JSON interchange lives in compiler.py; the crushtool CLI auto-detects
 the format.
@@ -148,10 +150,11 @@ def decompile_text(cmap: CrushMap) -> str:
     # children before parents (crushtool emits leaves-first so every
     # item name is defined before use)
     emitted = set()
+    shadow_ids = set(cmap.class_bucket.values())
 
     def emit_bucket(bid: int) -> None:
-        if bid in emitted:
-            return
+        if bid in emitted or bid in shadow_ids:
+            return  # shadow clones are derived state; crushtool hides them
         b = cmap.buckets[bid]
         for it in b.items:
             if it < 0:
@@ -161,6 +164,10 @@ def decompile_text(cmap: CrushMap) -> str:
         bname = cmap.item_names.get(bid, f"bucket{-bid}")
         out.append(f"{tname} {bname} {{")
         out.append(f"\tid {b.id}")
+        for (orig, cls), sid in sorted(cmap.class_bucket.items(),
+                                       key=lambda kv: -kv[1]):
+            if orig == bid:
+                out.append(f"\tid {sid} class {cls}")
         out.append(f"\t# weight {_fmt_weight(b.weight)}")
         out.append(f"\talg {BUCKET_ALG_NAMES[b.alg]}")
         out.append("\thash 0\t# rjenkins1")
@@ -184,6 +191,12 @@ def decompile_text(cmap: CrushMap) -> str:
         out.append(f"\tmax_size {r.max_size}")
         for op, a1, a2 in r.steps:
             if op == _TAKE:
+                shadow = cmap.shadow_of(a1) if a1 < 0 else None
+                if shadow is not None:
+                    orig, cls = shadow
+                    oname = cmap.item_names.get(orig, f"bucket{-orig}")
+                    out.append(f"\tstep take {oname} class {cls}")
+                    continue
                 tname_ = cmap.item_names.get(a1, f"bucket{-a1}" if a1 < 0
                                              else f"osd.{a1}")
                 out.append(f"\tstep take {tname_}")
@@ -306,16 +319,17 @@ def _parse_bucket(t: _Tokens, b, type_name: str, type_ids, name_to_id,
     alg = "straw2"
     items: List[int] = []
     weights: List[int] = []
+    shadow_ids: List[Tuple[int, str]] = []
     while True:
         tok = t.next()
         if tok == "}":
             break
         if tok == "id":
             bid = int(t.next())
-            if t.peek() == "class":  # shadow-tree id: "id -5 class hdd"
+            if t.peek() == "class":  # pinned shadow id: "id -5 class hdd"
                 t.next()
-                t.next()
-                continue  # shadow ids are derived state; skip
+                shadow_ids.append((bid, t.next()))
+                continue
             bucket_id = bid
         elif tok == "alg":
             alg = t.next()
@@ -350,6 +364,11 @@ def _parse_bucket(t: _Tokens, b, type_name: str, type_ids, name_to_id,
     b.add_bucket(alg, type_ids[type_name], items, weights,
                  bucket_id=bucket_id, name=bname)
     name_to_id[bname] = bucket_id
+    for sid, cls in shadow_ids:
+        # shadow buckets themselves are rebuilt by populate_classes;
+        # the pinned ids make the rebuild placement-identical to the
+        # cluster the map came from
+        cmap.class_bucket[(bucket_id, cls)] = sid
 
 
 def _parse_rule(t: _Tokens, b, name_to_id, type_ids) -> None:
@@ -388,10 +407,14 @@ def _parse_rule(t: _Tokens, b, name_to_id, type_ids) -> None:
                     raise ValueError(f"rule {rname!r}: take of undefined "
                                      "item")
                 if t.peek() == "class":
-                    raise ValueError(
-                        "class-filtered 'step take ... class ...' needs "
-                        "CrushWrapper shadow trees, which this framework "
-                        "does not build yet")
+                    t.next()
+                    cls = t.next()
+                    sid = b.map.class_bucket.get((item, cls))
+                    if sid is None or sid not in b.map.buckets:
+                        b.populate_classes()  # build (or honor pinned
+                        #                       ids from the bucket
+                        #                       blocks)
+                    item = b.get_shadow(item, cls)
                 steps.append((_TAKE, item, 0))
             elif op == "emit":
                 steps.append((_EMIT, 0, 0))

@@ -167,6 +167,16 @@ class CrushMap:
     # (straw_calc_version, allowed_bucket_algs, ...) — preserved for
     # round-trips
     extra_tunables: Dict[str, int] = field(default_factory=dict)
+    # CrushWrapper::class_bucket role: (original bucket id, class name)
+    # -> shadow bucket id (built by CrushBuilder.populate_classes)
+    class_bucket: Dict[Tuple[int, str], int] = field(default_factory=dict)
+
+    def shadow_of(self, bid: int) -> Optional[Tuple[int, str]]:
+        """(original id, class) when ``bid`` is a shadow bucket."""
+        for (orig, cls), sid in self.class_bucket.items():
+            if sid == bid:
+                return orig, cls
+        return None
 
     def bucket(self, item: int) -> Bucket:
         return self.buckets[item]

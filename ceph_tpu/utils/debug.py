@@ -23,8 +23,10 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 
 _ACTIVE = 0
+_ACTIVE_LOCK = threading.Lock()
 
 
 class DeviceVerificationError(AssertionError):
@@ -44,10 +46,12 @@ def debug_mode(nan_checks: bool = True):
     if nan_checks:
         prev_nan = jax.config.read("jax_debug_nans")
         jax.config.update("jax_debug_nans", True)
-    _ACTIVE += 1
+    with _ACTIVE_LOCK:
+        _ACTIVE += 1
     try:
         yield
     finally:
-        _ACTIVE -= 1
+        with _ACTIVE_LOCK:
+            _ACTIVE -= 1
         if nan_checks and prev_nan is not None:
             jax.config.update("jax_debug_nans", prev_nan)

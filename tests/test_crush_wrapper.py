@@ -1,0 +1,234 @@
+"""CrushWrapper-level map editing: device classes / shadow trees
+(populate_classes -> device_class_clone), adjust_item_weight,
+insert_item / remove_item — and class-filtered rules end-to-end."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (
+    CrushBuilder,
+    crush_do_rule,
+    step_chooseleaf_firstn,
+    step_emit,
+    step_take,
+)
+from ceph_tpu.crush.text_compiler import compile_text, decompile_text
+
+HOST, ROOT = 1, 2
+
+
+def build_classed():
+    """2 hosts x (1 ssd + 1 hdd), plus 1 all-ssd host."""
+    b = CrushBuilder()
+    b.add_type(HOST, "host")
+    b.add_type(ROOT, "root")
+    h0 = b.add_bucket("straw2", "host", [0, 1], name="h0")
+    h1 = b.add_bucket("straw2", "host", [2, 3], name="h1")
+    h2 = b.add_bucket("straw2", "host", [4, 5], name="h2")
+    root = b.add_bucket("straw2", "root", [h0, h1, h2], name="root")
+    for d in (0, 2, 4, 5):
+        b.set_item_class(d, "ssd")
+    for d in (1, 3):
+        b.set_item_class(d, "hdd")
+    return b, root, (h0, h1, h2)
+
+
+def test_populate_classes_structure():
+    b, root, hosts = build_classed()
+    b.populate_classes()
+    m = b.map
+    sroot = b.get_shadow(root, "ssd")
+    sb = m.buckets[sroot]
+    # ssd shadows of all three hosts, weights = ssd item sums
+    assert len(sb.items) == 3
+    assert m.item_names[sroot] == "root~ssd"
+    ssd_devs = {d for h in sb.items for d in m.buckets[h].items}
+    assert ssd_devs == {0, 2, 4, 5}
+    hroot = b.get_shadow(root, "hdd")
+    hdd_devs = {d for h in m.buckets[hroot].items
+                for d in m.buckets[h].items}
+    assert hdd_devs == {1, 3}
+    # h2 has no hdd device -> no hdd shadow for it
+    with pytest.raises(ValueError, match="no class"):
+        b.get_shadow(hosts[2], "hdd")
+    assert m.buckets[sroot].weight == 4 * 0x10000
+    assert m.buckets[hroot].weight == 2 * 0x10000
+
+
+def test_class_rule_places_only_class_devices():
+    b, root, _ = build_classed()
+    b.populate_classes()
+    b.add_rule(0, [step_take(b.get_shadow(root, "ssd")),
+                   step_chooseleaf_firstn(0, HOST), step_emit()])
+    b.add_rule(1, [step_take(b.get_shadow(root, "hdd")),
+                   step_chooseleaf_firstn(0, HOST), step_emit()])
+    for x in range(200):
+        ssd = crush_do_rule(b.map, 0, x, 3)
+        assert set(ssd) <= {0, 2, 4, 5} and len(ssd) == 3
+        hdd = crush_do_rule(b.map, 1, x, 2)
+        assert set(hdd) <= {1, 3} and len(hdd) == 2
+
+
+def test_shadow_placement_matches_filtered_map():
+    """A shadow tree is placement-identical to a hand-built map holding
+    only the class devices — when the bucket ids match (interior straw2
+    choices hash the child BUCKET ids, which is exactly why the text
+    format pins shadow ids with 'id -N class C' lines)."""
+    b, root, (h0, h1, h2) = build_classed()
+    b.populate_classes()
+    b.add_rule(0, [step_take(b.get_shadow(root, "ssd")),
+                   step_chooseleaf_firstn(0, HOST), step_emit()])
+    f = CrushBuilder()
+    f.add_type(HOST, "host")
+    f.add_type(ROOT, "root")
+    fh0 = f.add_bucket("straw2", "host", [0],
+                       bucket_id=b.get_shadow(h0, "ssd"))
+    fh1 = f.add_bucket("straw2", "host", [2],
+                       bucket_id=b.get_shadow(h1, "ssd"))
+    fh2 = f.add_bucket("straw2", "host", [4, 5],
+                       bucket_id=b.get_shadow(h2, "ssd"))
+    froot = f.add_bucket("straw2", "root", [fh0, fh1, fh2],
+                         bucket_id=b.get_shadow(root, "ssd"))
+    f.add_rule(0, [step_take(froot), step_chooseleaf_firstn(0, HOST),
+                   step_emit()])
+    for x in range(300):
+        assert crush_do_rule(b.map, 0, x, 3) == \
+            crush_do_rule(f.map, 0, x, 3), x
+
+
+def test_pinned_shadow_ids_round_trip():
+    """'id -N class C' lines pin shadow ids, so a decompiled map
+    recompiles to the same shadow numbering and identical class-rule
+    placements."""
+    m1 = compile_text(CLASS_MAP_TEXT)
+    text = decompile_text(m1)
+    assert "class ssd\t" not in text  # ids live inside bucket blocks
+    m2 = compile_text(text)
+    assert m1.class_bucket == m2.class_bucket
+    for x in range(100):
+        assert crush_do_rule(m1, 0, x, 2) == crush_do_rule(m2, 0, x, 2)
+
+
+def test_class_rule_bulk_matches_host():
+    bulk = pytest.importorskip("ceph_tpu.crush.bulk")
+    b, root, _ = build_classed()
+    b.populate_classes()
+    b.add_rule(0, [step_take(b.get_shadow(root, "ssd")),
+                   step_chooseleaf_firstn(0, HOST), step_emit()])
+    out, cnt = bulk.bulk_do_rule(b.map, 0, np.arange(200), 3)
+    for x in range(200):
+        ref = crush_do_rule(b.map, 0, x, 3)
+        assert list(out[x])[:len(ref)] == ref, x
+
+
+CLASS_MAP_TEXT = """\
+device 0 osd.0 class ssd
+device 1 osd.1 class hdd
+device 2 osd.2 class ssd
+device 3 osd.3 class hdd
+type 0 osd
+type 1 host
+type 2 root
+host h0 { id -2 alg straw2 hash 0 item osd.0 weight 1.0 item osd.1 weight 1.0 }
+host h1 { id -3 alg straw2 hash 0 item osd.2 weight 1.0 item osd.3 weight 1.0 }
+root default { id -1 alg straw2 hash 0 item h0 weight 2.0 item h1 weight 2.0 }
+rule ssd_rule {
+    id 0
+    type replicated
+    step take default class ssd
+    step chooseleaf firstn 0 type host
+    step emit
+}
+"""
+
+
+def test_text_class_take_end_to_end():
+    m = compile_text(CLASS_MAP_TEXT)
+    for x in range(100):
+        res = crush_do_rule(m, 0, x, 2)
+        assert set(res) <= {0, 2} and len(res) == 2
+    # decompile hides the shadows and restores the class-take form
+    text = decompile_text(m)
+    assert "step take default class ssd" in text
+    assert "~ssd" not in text
+    m2 = compile_text(text)
+    for x in range(100):
+        assert crush_do_rule(m, 0, x, 2) == crush_do_rule(m2, 0, x, 2)
+
+
+def test_adjust_item_weight_propagates():
+    b, root, (h0, h1, h2) = build_classed()
+    b.populate_classes()
+    old_root_w = b.map.buckets[root].weight
+    assert b.adjust_item_weight(0, 0x30000) == 1
+    assert b.map.buckets[h0].item_weights[0] == 0x30000
+    # parent's entry for h0 and the root total both moved by +2.0
+    i = b.map.buckets[root].items.index(h0)
+    assert b.map.buckets[root].item_weights[i] == 0x40000
+    assert b.map.buckets[root].weight == old_root_w + 0x20000
+    # shadows rebuilt with the new weight
+    s = b.get_shadow(root, "ssd")
+    assert b.map.buckets[s].weight == 6 * 0x10000
+
+
+def test_insert_and_remove_item():
+    b, root, (h0, h1, h2) = build_classed()
+    b.populate_classes()
+    b.insert_item(6, 0x10000, h2, name="osd.6", class_name="hdd")
+    assert 6 in b.map.buckets[h2].items
+    assert b.map.max_devices == 7
+    # h2 now has an hdd shadow
+    s = b.get_shadow(h2, "hdd")
+    assert b.map.buckets[s].items == [6]
+    assert b.remove_item(6) == 1
+    with pytest.raises(ValueError, match="no class"):
+        b.get_shadow(h2, "hdd")
+    # root weight restored
+    assert b.map.buckets[root].weight == 6 * 0x10000
+
+
+def test_uniform_adjust_guard():
+    b = CrushBuilder()
+    b.add_type(1, "root")
+    root = b.add_bucket("uniform", 1, [0, 1, 2], [0x10000] * 3)
+    with pytest.raises(ValueError, match="uniform"):
+        b.adjust_item_weight(1, 0x20000)
+
+
+def test_class_dies_out_sweeps_stale_shadows():
+    """Removing a class's last device must drop its shadows — a rule
+    taking the vanished class errors instead of mapping to the removed
+    device."""
+    b, root, (h0, h1, h2) = build_classed()
+    b.populate_classes()
+    assert b.get_shadow(root, "hdd") in b.map.buckets
+    b.remove_item(1)
+    b.remove_item(3)  # last hdd device
+    with pytest.raises(ValueError, match="no class"):
+        b.get_shadow(root, "hdd")
+    assert not any(cls == "hdd" for (_, cls) in b.map.class_bucket)
+    # ssd shadows still intact
+    assert b.get_shadow(root, "ssd") in b.map.buckets
+
+
+def test_remove_nonempty_bucket_refused():
+    b, root, (h0, h1, h2) = build_classed()
+    with pytest.raises(ValueError, match="not empty"):
+        b.remove_item(h0)
+    # empty it, then removal also deletes the node
+    b.remove_item(0)
+    b.remove_item(1)
+    assert b.remove_item(h0) == 1
+    assert h0 not in b.map.buckets
+    assert h0 not in b.map.buckets[root].items
+
+
+def test_pinned_shadow_id_without_class_devices_errors():
+    """A map pinning 'id -9 class hdd' whose hdd devices are gone must
+    fail the class take at compile time, not KeyError at mapping time."""
+    text = CLASS_MAP_TEXT.replace(" class hdd", "").replace(
+        "host h0 { id -2 ", "host h0 { id -2 id -9 class hdd ")
+    bad = text.replace("step take default class ssd",
+                       "step take default class hdd")
+    with pytest.raises(ValueError, match="no class"):
+        compile_text(bad)

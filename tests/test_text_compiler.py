@@ -211,7 +211,9 @@ def test_parse_errors():
     with pytest.raises(ValueError, match="undefined item"):
         compile_text("type 0 osd\ntype 1 host\nhost h { id -1 alg straw2 "
                      "hash 0 item osd.9 weight 1.0 }")
-    with pytest.raises(ValueError, match="shadow trees"):
+    with pytest.raises(ValueError, match="no class"):
+        # REAL_MAP has no hdd-classed device: the class take must fail
+        # with a clear error, not a silent empty mapping
         compile_text(REAL_MAP.replace("step take default",
                                       "step take default class hdd", 1))
     with pytest.raises(ValueError, match="rjenkins1"):
