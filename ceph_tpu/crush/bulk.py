@@ -364,6 +364,55 @@ def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
     return jnp.where(res == UNDEF, NONE, res), need_host
 
 
+def _chained_single(cm, takes, count, x, type_, recurse_to_leaf,
+                    weight_vec, T, firstn, from_type):
+    """A SECOND choose step over the previous step's output vector
+    (mapper.c: per input bucket a fresh segment, outpos=0), numrep=1
+    per segment — the common chained EC shape (choose N type rack ->
+    chooseleaf 1 type host).
+
+    Candidates for every (try, segment) pair come from two batched
+    descents (segments are independent: r restarts per segment and
+    numrep=1 segments cannot self-collide); per segment the first
+    acceptable try wins.  firstn semantics: a segment that places
+    nothing (or an invalid take inside the segment range) shifts
+    downstream packing in mapper.c, so those lanes re-run on the host;
+    indep leaves a NONE hole in place."""
+    R = takes.shape[0]
+    # r = ftotal for both modes at numrep=1 (firstn: rep+parent_r+ftotal
+    # with rep=parent_r=0; indep: rep+numrep*ftotal with rep=0,numrep=1)
+    rs = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int64)[:, None], (T, R))
+    items, ok = _descend(cm, takes[None, :], x, rs, type_,
+                         cm.descend_steps(from_type, type_), 0)
+    if recurse_to_leaf:
+        # jewel semantics: recursion rep 0, sub_r = r, one leaf try
+        leaves, lok = _descend(cm, items, x, rs, 0,
+                               cm.descend_steps(type_, 0), 0)
+        lout = _is_out(weight_vec, leaves, x)
+        ok = ok & lok & ~lout
+    else:
+        leaves = items
+        if type_ == 0:
+            ok = ok & ~_is_out(weight_vec, items, x)
+    in_seg = jnp.arange(R) < count
+    valid_take = takes < 0
+    # an invalid take inside the segment range is skipped entirely by
+    # mapper.c (osize does not advance) — positions shift: host lane
+    need_host = jnp.any(in_seg & ~valid_take)
+    ok = ok & (in_seg & valid_take)[None, :]
+    first = jnp.argmax(ok, axis=0)                       # (R,)
+    any_ok = jnp.any(ok, axis=0)
+    pick = leaves if recurse_to_leaf else items
+    sel = jnp.take_along_axis(pick, first[None, :], axis=0)[0]
+    out = jnp.where(any_ok, sel, NONE).astype(jnp.int32)
+    # a segment that exhausted the device try budget may still place
+    # within C's choose_total_tries: host fallback decides (for firstn
+    # the failure also shifts packing; for indep the hole may be a
+    # budget artifact — same conservative flag as _choose_indep)
+    need_host = need_host | jnp.any(in_seg & valid_take & ~any_ok)
+    return out, need_host
+
+
 def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
                  bulk_tries: int = DEFAULT_BULK_TRIES):
     """Build fn(x, weight_vec) -> (results, count, need_host)."""
@@ -393,47 +442,65 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
         results = []
         take = None
         current = None
+        current_type = None  # bucket type the last choose produced
         need_host = jnp.asarray(False)
         for op, arg1, arg2 in steps:
             if op == CRUSH_RULE_TAKE:
                 take = arg1
                 current = None
+                current_type = None
             elif op in (CRUSH_RULE_CHOOSE_FIRSTN,
                         CRUSH_RULE_CHOOSELEAF_FIRSTN):
+                recurse = op == CRUSH_RULE_CHOOSELEAF_FIRSTN
                 if current is not None:
-                    # mapper.c iterates a second choose over the first's
-                    # output vector; that chaining is host-mapper-only
-                    raise ValueError(
-                        "bulk evaluator does not support chained choose "
-                        "steps (choose after choose without emit); use "
-                        "engine=host")
+                    if arg1 != 1:
+                        raise ValueError(
+                            "bulk evaluator supports chained choose "
+                            "steps only with n=1 (the chooseleaf-per-"
+                            "domain EC shape); use engine=host")
+                    vals, nh = _chained_single(
+                        cm, current[0], current[1], x, arg2, recurse,
+                        weight_vec, T, True, current_type)
+                    need_host = need_host | nh
+                    current = (vals, current[1])
+                    current_type = arg2
+                    continue
                 numrep = arg1 if arg1 > 0 else arg1 + result_max
                 numrep = min(numrep, result_max)  # C: count = out_size cap
                 take_type = (cm.cmap.buckets[take].type
                              if take in cm.cmap.buckets else None)
                 vals, count, nh = _choose_firstn(
-                    cm, take, x, numrep, arg2,
-                    op == CRUSH_RULE_CHOOSELEAF_FIRSTN, weight_vec, T,
+                    cm, take, x, numrep, arg2, recurse, weight_vec, T,
                     take_type)
                 need_host = need_host | nh
                 current = (vals, count)
+                current_type = arg2
             elif op in (CRUSH_RULE_CHOOSE_INDEP,
                         CRUSH_RULE_CHOOSELEAF_INDEP):
+                recurse = op == CRUSH_RULE_CHOOSELEAF_INDEP
                 if current is not None:
-                    raise ValueError(
-                        "bulk evaluator does not support chained choose "
-                        "steps (choose after choose without emit); use "
-                        "engine=host")
+                    if arg1 != 1:
+                        raise ValueError(
+                            "bulk evaluator supports chained choose "
+                            "steps only with n=1 (the chooseleaf-per-"
+                            "domain EC shape); use engine=host")
+                    vals, nh = _chained_single(
+                        cm, current[0], current[1], x, arg2, recurse,
+                        weight_vec, T, False, current_type)
+                    need_host = need_host | nh
+                    current = (vals, current[1])
+                    current_type = arg2
+                    continue
                 numrep = arg1 if arg1 > 0 else arg1 + result_max
                 numrep = min(numrep, result_max)
                 take_type = (cm.cmap.buckets[take].type
                              if take in cm.cmap.buckets else None)
                 vals, nh = _choose_indep(
-                    cm, take, x, numrep, arg2,
-                    op == CRUSH_RULE_CHOOSELEAF_INDEP, weight_vec, T,
+                    cm, take, x, numrep, arg2, recurse, weight_vec, T,
                     take_type)
                 need_host = need_host | nh
                 current = (vals, jnp.int32(vals.shape[0]))
+                current_type = arg2
             elif op == CRUSH_RULE_EMIT:
                 if current is not None:
                     results.append(current)

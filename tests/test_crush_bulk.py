@@ -118,10 +118,10 @@ def test_bulk_gates_unsupported_shapes():
     envelope must raise (and run on the host engine) rather than
     silently diverge."""
     from ceph_tpu.crush import Tunables, step_choose_firstn
-    # chained choose steps
+    # chained choose steps with n > 1 (n=1 chains run fused)
     b, root = build(4, 3)
-    b.add_rule(0, [step_take(root), step_choose_firstn(3, 1),
-                   step_choose_firstn(1, 0), step_emit()])
+    b.add_rule(0, [step_take(root), step_choose_firstn(2, 1),
+                   step_choose_firstn(2, 0), step_emit()])
     with pytest.raises(ValueError, match="chained"):
         bulk.bulk_do_rule(b.map, 0, np.arange(4), 3)
     # pre-jewel tunables
@@ -231,3 +231,79 @@ def test_bulk_choose_args_changes_placement():
     skew, _ = bulk.bulk_do_rule(b.map, 0, np.arange(200), 3,
                                 choose_args=args)
     assert not np.array_equal(base, skew)
+
+
+def build3level(n_racks, hosts_per_rack, devs, seed=None):
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "rack")
+    b.add_type(3, "root")
+    rng = np.random.default_rng(seed) if seed is not None else None
+    racks = []
+    d = 0
+    for _ in range(n_racks):
+        hosts = []
+        for _ in range(hosts_per_rack):
+            nd = devs if rng is None else int(rng.integers(1, devs + 1))
+            ws = None if rng is None else [
+                int(w) for w in rng.integers(0x8000, 0x30000, nd)]
+            hosts.append(b.add_bucket("straw2", "host",
+                                      list(range(d, d + nd)), ws))
+            d += nd
+        racks.append(b.add_bucket("straw2", "rack", hosts))
+    root = b.add_bucket("straw2", "root", racks)
+    return b, root
+
+
+CHAIN_STEPS = {
+    "indep_chain": lambda r: [step_take(r), step_choose_indep(0, 2),
+                              step_chooseleaf_indep(1, 1), step_emit()],
+    "firstn_chain": lambda r: [step_take(r), step_choose_firstn(0, 2),
+                               step_chooseleaf_firstn(1, 1), step_emit()],
+    "indep_to_osd": lambda r: [step_take(r), step_choose_indep(0, 2),
+                               step_choose_indep(1, 1),
+                               step_choose_indep(1, 0), step_emit()],
+}
+
+
+@pytest.mark.parametrize("shape", sorted(CHAIN_STEPS))
+def test_bulk_chained_matches_host(shape):
+    """The common chained EC shape (choose N type rack -> chooseleaf 1
+    type host) runs fused on device, pinned vs the host mapper."""
+    b, root = build3level(4, 2, 2)
+    b.add_rule(0, CHAIN_STEPS[shape](root))
+    pin(b, 0, 3, N=400)
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_bulk_chained_irregular_weighted(seed):
+    b, root = build3level(3, 2, 3, seed=seed)
+    b.add_rule(0, CHAIN_STEPS["indep_chain"](root))
+    b.add_rule(1, CHAIN_STEPS["firstn_chain"](root))
+    pin(b, 0, 3, N=250)
+    pin(b, 1, 3, N=250)
+
+
+def test_bulk_chained_with_reweights_and_choose_args():
+    rng = np.random.default_rng(3)
+    b, root = build3level(3, 2, 2)
+    b.add_rule(0, CHAIN_STEPS["indep_chain"](root))
+    w = b.map.device_weights()
+    w[0] = 0
+    w[5] = 0x6000
+    pin(b, 0, 3, N=250, weight=w)
+    args = _random_choose_args(b, rng)
+    out, _ = bulk.bulk_do_rule(b.map, 0, np.arange(250), 3,
+                               choose_args=args)
+    for x in range(250):
+        ref = crush_do_rule(b.map, 0, x, 3, choose_args=args)
+        ref = ref + [CRUSH_ITEM_NONE] * (3 - len(ref))
+        assert list(out[x]) == ref, (x, ref, list(out[x]))
+
+
+def test_bulk_chained_overload_holes():
+    """numrep > racks: indep chains leave NONE holes where the domain
+    pick failed — exactly like the host mapper."""
+    b, root = build3level(2, 2, 2)
+    b.add_rule(0, CHAIN_STEPS["indep_chain"](root))
+    pin(b, 0, 4, N=200)
