@@ -27,14 +27,20 @@ import sys
 from ..crush.builder import CrushBuilder
 from ..crush.compiler import compile_map, decompile
 from ..crush.tester import test_rule
+from ..crush.binary import CRUSH_MAGIC, decode_map, encode_map
 from ..crush.text_compiler import compile_text, decompile_text
 from ..crush.types import CRUSH_ITEM_NONE
 
+import struct
+
 
 def read_map(path: str):
-    """Auto-detect interchange form: JSON ('{' first) or crushtool
-    text grammar."""
-    text = open(path).read()
+    """Auto-detect interchange form: binary (CRUSH_MAGIC), JSON ('{'
+    first), or crushtool text grammar."""
+    raw = open(path, "rb").read()
+    if len(raw) >= 4 and struct.unpack("<I", raw[:4])[0] == CRUSH_MAGIC:
+        return decode_map(raw)
+    text = raw.decode()
     if text.lstrip().startswith("{"):
         return compile_map(text)
     return compile_text(text)
@@ -49,9 +55,9 @@ def main(argv=None) -> int:
                    help="output map (text; JSON for .json suffix)")
     p.add_argument("-d", "--decompile", metavar="MAP",
                    help="print the crushtool text form of MAP")
-    p.add_argument("--format", choices=("text", "json"),
+    p.add_argument("--format", choices=("text", "json", "bin"),
                    help="output form for -d/-o (default: text, or by "
-                        "-o suffix)")
+                        "-o suffix: .json / .bin)")
     p.add_argument("--choose-args", metavar="NAME",
                    help="apply the named choose_args set during --test")
     p.add_argument("--build-two-level", nargs=2, type=int,
@@ -91,11 +97,17 @@ def main(argv=None) -> int:
         p.error("need -i MAP or --build-two-level")
 
     if args.outfn:
-        as_json = (args.format == "json"
-                   or (args.format is None
-                       and args.outfn.endswith(".json")))
-        with open(args.outfn, "w") as f:
-            f.write(decompile(cmap) if as_json else decompile_text(cmap))
+        fmt = args.format
+        if fmt is None:
+            fmt = ("json" if args.outfn.endswith(".json")
+                   else "bin" if args.outfn.endswith(".bin") else "text")
+        if fmt == "bin":
+            with open(args.outfn, "wb") as f:
+                f.write(encode_map(cmap))
+        else:
+            with open(args.outfn, "w") as f:
+                f.write(decompile(cmap) if fmt == "json"
+                        else decompile_text(cmap))
         print(f"wrote {args.outfn}", file=sys.stderr)
 
     if args.test:

@@ -13,9 +13,10 @@ mapper.c, CrushWrapper.{h,cc}, CrushTester.{h,cc}):
 - ``bulk``    — the TPU-native bulk evaluator: straw2 hierarchies
   evaluated for millions of inputs at once via vmapped jax.
 - ``tester``  — CrushTester-style mapping sweeps + statistics.
-- ``compiler`` / ``text_compiler`` — JSON and crushtool-text-grammar
-  compile/decompile (CrushCompiler role); real cluster maps decompiled
-  by crushtool drive the evaluators directly.
+- ``compiler`` / ``text_compiler`` / ``binary`` — JSON, crushtool
+  text grammar, and binary (CrushWrapper::encode/decode wire form)
+  compile/decompile; real cluster maps (text or `ceph osd getcrushmap`
+  blobs) drive the evaluators directly.
 """
 
 from .types import (  # noqa: F401
@@ -35,3 +36,4 @@ from .builder import CrushBuilder  # noqa: F401
 from .mapper import crush_do_rule  # noqa: F401
 from .compiler import compile_map, decompile  # noqa: F401
 from .text_compiler import compile_text, decompile_text  # noqa: F401
+from .binary import decode_map, encode_map  # noqa: F401

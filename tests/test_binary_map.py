@@ -1,0 +1,113 @@
+"""Binary crushmap encode/decode round-trips (CrushWrapper::encode/
+::decode role).  Layout is reconstructed from upstream knowledge (mount
+empty — see binary.py header); these tests pin self-consistency and
+placement identity, to be re-verified against real getcrushmap blobs
+when the mount is repaired."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import crush_do_rule
+from ceph_tpu.crush.binary import CRUSH_MAGIC, decode_map, encode_map
+from ceph_tpu.crush.text_compiler import compile_text
+from test_crush_golden import _alg_maps
+from test_crush_wrapper import CLASS_MAP_TEXT
+from test_text_compiler import REAL_MAP
+
+
+def _roundtrip(m):
+    blob = encode_map(m)
+    assert int.from_bytes(blob[:4], "little") == CRUSH_MAGIC
+    return decode_map(blob)
+
+
+def test_real_map_round_trip_fields_and_placements():
+    m1 = compile_text(REAL_MAP)
+    m2 = _roundtrip(m1)
+    assert sorted(m1.buckets) == sorted(m2.buckets)
+    for bid in m1.buckets:
+        b1, b2 = m1.buckets[bid], m2.buckets[bid]
+        assert (b1.items, b1.item_weights, b1.alg, b1.type,
+                b1.weight) == (b2.items, b2.item_weights, b2.alg,
+                               b2.type, b2.weight), bid
+    assert {r: m1.rules[r].steps for r in m1.rules} == \
+        {r: m2.rules[r].steps for r in m2.rules}
+    assert m2.rules[1].name == "ec_rule" and m2.rules[1].type == 3
+    assert vars(m1.tunables) == vars(m2.tunables)
+    assert m2.extra_tunables["straw_calc_version"] == 1
+    ca1, ca2 = m1.choose_args["0"], m2.choose_args["0"]
+    for bid in ca1:
+        assert ca1[bid].weight_set == ca2[bid].weight_set
+        assert ca1[bid].ids == ca2[bid].ids
+    for x in range(100):
+        assert crush_do_rule(m1, 0, x, 3) == crush_do_rule(m2, 0, x, 3)
+        assert crush_do_rule(m1, 1, x, 4) == crush_do_rule(m2, 1, x, 4)
+
+
+@pytest.mark.parametrize("alg,b", _alg_maps(),
+                         ids=[a for a, _ in _alg_maps()])
+def test_all_bucket_algs_round_trip(alg, b):
+    m2 = _roundtrip(b.map)
+    bk1 = {bid: b.map.buckets[bid] for bid in b.map.buckets}
+    for bid, b1 in bk1.items():
+        b2 = m2.buckets[bid]
+        assert b1.items == b2.items and b1.item_weights == b2.item_weights
+        assert b1.sum_weights == b2.sum_weights            # list
+        assert b1.node_weights == b2.node_weights          # tree
+        assert b1.straws == b2.straws                      # straw
+    for x in range(64):
+        assert crush_do_rule(b.map, 0, x, 3) == crush_do_rule(m2, 0, x, 3)
+        assert crush_do_rule(b.map, 1, x, 3) == crush_do_rule(m2, 1, x, 3)
+
+
+def test_classes_and_shadows_round_trip():
+    m1 = compile_text(CLASS_MAP_TEXT)
+    m2 = _roundtrip(m1)
+    assert m2.device_classes == m1.device_classes
+    assert m2.class_bucket == m1.class_bucket
+    for x in range(100):
+        assert crush_do_rule(m1, 0, x, 2) == crush_do_rule(m2, 0, x, 2)
+
+
+def test_bad_magic_rejected():
+    with pytest.raises(ValueError, match="magic"):
+        decode_map(b"\x00\x00\x00\x99" + b"\x00" * 64)
+
+
+def test_sparse_ids_round_trip():
+    """Bucket-id and rule-id holes survive (slot encoding)."""
+    from ceph_tpu.crush import CrushBuilder, step_take, step_emit
+    from ceph_tpu.crush.types import step_chooseleaf_firstn
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    h = b.add_bucket("straw2", "host", [0, 1], bucket_id=-5)
+    root = b.add_bucket("straw2", "root", [h], bucket_id=-9)
+    b.add_rule(3, [step_take(root), step_chooseleaf_firstn(0, 1),
+                   step_emit()], name="r3")
+    m2 = _roundtrip(b.map)
+    assert sorted(m2.buckets) == [-9, -5]
+    assert sorted(m2.rules) == [3]
+    for x in range(50):
+        assert crush_do_rule(b.map, 3, x, 2) == crush_do_rule(m2, 3, x, 2)
+
+
+def test_crushtool_cli_binary(tmp_path, capsys):
+    from ceph_tpu.bench.crushtool import main
+    mp = tmp_path / "map.txt"
+    mp.write_text(REAL_MAP)
+    bp = tmp_path / "map.bin"
+    assert main(["-i", str(mp), "-o", str(bp)]) == 0
+    assert bp.read_bytes()[:4] == CRUSH_MAGIC.to_bytes(4, "little")
+    capsys.readouterr()
+    assert main(["-i", str(bp), "--test", "--rule", "0", "--num-rep",
+                 "3", "--max-x", "63", "--engine", "host",
+                 "--show-statistics"]) == 0
+    assert "num_mappings 64" in capsys.readouterr().out
+    # decompile binary -> text round-trip
+    assert main(["-d", str(bp)]) == 0
+    text = capsys.readouterr().out
+    m2 = compile_text(text)
+    m1 = compile_text(REAL_MAP)
+    for x in range(50):
+        assert crush_do_rule(m1, 0, x, 3) == crush_do_rule(m2, 0, x, 3)
