@@ -276,6 +276,48 @@ class ErasureCodeBench:
         allchunks = self._place_chunks(ec, data, parity)
         patterns = self._erasure_patterns(ec, n)
 
+        if a.device == "jax" and a.loop:
+            # device decode throughput: N chained decodes of one fixed
+            # erasure pattern inside a single dispatch (mirror of the
+            # encode --loop mode; slabs pre-materialized, XOR-distinct
+            # so nothing hoists or CSEs)
+            import jax
+            import jax.numpy as jnp
+            pat = patterns[0]
+            available = tuple(i for i in range(n) if i not in pat)
+            n_slabs = min(a.loop, 8)
+            reps = -(-a.loop // n_slabs)
+            avail_idx = np.array(available)
+            gen = jax.jit(lambda d: (d[None] ^ jnp.arange(
+                n_slabs, dtype=jnp.uint8)[:, None, None, None]
+            )[:, :, avail_idx, :])
+            slabs = gen(jax.device_put(allchunks))
+            np.asarray(slabs[0, 0, 0, :4])  # materialize
+
+            @jax.jit
+            def chained(slabs):
+                def step(carry, slab):
+                    out = ec.decode_chunks_jax(slab, available, pat)
+                    return carry ^ out, None
+
+                init = jnp.zeros((allchunks.shape[0], len(pat),
+                                  allchunks.shape[2]), jnp.uint8)
+
+                def rep(carry, _):
+                    c, _ = jax.lax.scan(step, carry, slabs)
+                    return c, None
+
+                out, _ = jax.lax.scan(rep, init, None, length=reps)
+                return out
+
+            out = chained(slabs)
+            np.asarray(out[0, 0, :4])
+            begin = time.perf_counter()
+            out = chained(slabs)
+            np.asarray(out[0, 0, :4])
+            elapsed = time.perf_counter() - begin
+            total_bytes = data.nbytes * n_slabs * reps
+            return self._result("decode", elapsed, total_bytes)
         if a.device == "jax":
             import jax
             dev = jax.device_put(allchunks)

@@ -114,3 +114,22 @@ def test_loop_mode_result_is_xor_of_slab_parities():
         return carry ^ ec.encode_chunks_jax(slab), None
     out, _ = jax.lax.scan(step, jnp.zeros((2, 2, chunk), jnp.uint8), slabs)
     assert np.array_equal(np.asarray(out), expect)
+
+
+@pytest.mark.parametrize("plugin,profile", [
+    ("jerasure", ["--parameter", "k=4", "--parameter", "m=2"]),
+    ("shec", ["--parameter", "k=4", "--parameter", "m=3",
+              "--parameter", "c=2"]),
+    ("clay", ["--parameter", "k=4", "--parameter", "m=2",
+              "--parameter", "d=5"]),
+])
+def test_decode_loop_mode(plugin, profile):
+    """--loop decode: chained device decodes of one erasure pattern
+    (the BASELINE decode-row measurement path) for the plugin families
+    with distinct repair math."""
+    res = run_bench(["--plugin", plugin, *profile, "--size", "8192",
+                     "--batch", "2", "--device", "jax",
+                     "--workload", "decode", "--erasures", "1",
+                     "--loop", "4"])
+    assert res["workload"] == "decode"
+    assert res["total_bytes"] > 0 and res["gbps"] > 0
