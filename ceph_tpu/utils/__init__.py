@@ -6,7 +6,18 @@
 - ``debug`` — sanitizer-equivalent switches (SURVEY.md §5 race/
   sanitizer row): jax debug_nans/checkify-style verification mode for
   the compute paths.
+- ``config`` — typed option schema (options.cc role) + the
+  erasure-code-profile store (`ceph osd erasure-code-profile`,
+  OSDMonitor validation-by-instantiation).
+- ``log`` — dout-style per-subsystem leveled debug logging.
 """
 
 from .perf import PerfCounters, global_perf, profile_trace  # noqa: F401
 from .debug import debug_mode, verification_enabled  # noqa: F401
+from .config import (  # noqa: F401
+    Config,
+    ErasureCodeProfileStore,
+    Option,
+    global_config,
+)
+from .log import dout, get_level, set_level  # noqa: F401

@@ -126,3 +126,60 @@ def test_bench_dump_perf(capsys):
     err = capsys.readouterr().err
     perf = json.loads(err.strip().splitlines()[-1])
     assert "ceph_tpu" in perf
+
+
+def test_config_schema_and_env(monkeypatch):
+    from ceph_tpu.utils import Config
+    c = Config()
+    assert c.get("crush_bulk_tries") == 8
+    monkeypatch.setenv("CEPH_TPU_CRUSH_BULK_TRIES", "16")
+    assert c.get("crush_bulk_tries") == 16
+    c.set("crush_bulk_tries", "4")   # explicit beats env
+    assert c.get("crush_bulk_tries") == 4
+    with pytest.raises(ValueError, match="max"):
+        c.set("crush_bulk_tries", 1000)
+    with pytest.raises(KeyError):
+        c.get("no_such_option")
+    assert c.get("debug_verify") is False
+    d = c.dump()
+    assert d["crush_bulk_tries"] == 4 and "log_level" in d
+
+
+def test_profile_store_validates_by_instantiation():
+    from ceph_tpu.utils import ErasureCodeProfileStore
+    store = ErasureCodeProfileStore()
+    store.set("ec83", {"plugin": "jerasure", "technique": "reed_sol_van",
+                       "k": 8, "m": 3,
+                       "crush-failure-domain": "host"})
+    assert store.get("ec83")["k"] == "8"
+    assert "ec83" in store.ls() and "default" in store.ls()
+    # a profile the plugin rejects never lands in the store
+    with pytest.raises(Exception):
+        store.set("bad", {"plugin": "jerasure", "technique": "nope"})
+    assert "bad" not in store.ls()
+    with pytest.raises(ValueError, match="already exists"):
+        store.set("ec83", {"plugin": "jerasure"})
+    ec = store.instantiate("ec83")
+    assert ec.get_chunk_count() == 11
+    store.rm("ec83")
+    assert "ec83" not in store.ls()
+    # the implicit default profile instantiates too
+    assert store.instantiate("default").get_chunk_count() == 3
+
+
+def test_dout_levels(monkeypatch):
+    import io
+    from ceph_tpu.utils.log import dout, set_level, set_stream
+    buf = io.StringIO()
+    set_stream(buf)
+    try:
+        set_level("crush", 5)
+        dout("crush", 5, "visible")
+        dout("crush", 6, "hidden")
+        monkeypatch.setenv("CEPH_TPU_DEBUG", "ec=10")
+        dout("ec", 10, "env-visible")
+    finally:
+        set_stream(None)
+    out = buf.getvalue()
+    assert "visible" in out and "env-visible" in out
+    assert "hidden" not in out
