@@ -23,7 +23,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import regionops
-from ..ops.pallas_gf import apply_matrix_best
+from ..ops.pallas_gf import apply_bitmatrix_best, apply_matrix_best
 from ..utils.debug import DeviceVerificationError, verification_enabled
 from ..utils.perf import global_perf
 from ..ops.xla_ops import (
@@ -141,7 +141,7 @@ class BitmatrixCodeMixin:
         perf.inc("ec_device_calls")
         perf.inc("ec_device_bytes", chunks.nbytes)
         with perf.timed("ec_device_time"):
-            out = np.asarray(apply_bitmatrix_xla(
+            out = np.asarray(apply_bitmatrix_best(
                 chunks, bitmatrix_static, self.w, self.packetsize))
         if verification_enabled():
             ref = regionops.bitmatrix_encode(chunks, bitmatrix, self.w,
@@ -180,8 +180,8 @@ class BitmatrixCodeMixin:
 
     def encode_chunks_jax(self, data):
         """(batch, k, C) uint8 device array -> (batch, m, C) parity on device."""
-        return apply_bitmatrix_xla(data, self._bitmatrix_static, self.w,
-                                   self.packetsize)
+        return apply_bitmatrix_best(data, self._bitmatrix_static, self.w,
+                                    self.packetsize)
 
     def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
         """(batch, len(available), C) device array -> (batch, len(erased), C)."""
@@ -189,5 +189,5 @@ class BitmatrixCodeMixin:
             raise IOError(f"need {self.k} chunks, have {len(available)}")
         _, dm_static, ns = self._decode_bitmatrix(tuple(available),
                                                   tuple(erased))
-        return apply_bitmatrix_xla(chunks[..., :ns, :], dm_static, self.w,
-                                   self.packetsize)
+        return apply_bitmatrix_best(chunks[..., :ns, :], dm_static, self.w,
+                                    self.packetsize)

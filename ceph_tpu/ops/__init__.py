@@ -5,9 +5,10 @@ Three tiers, all byte-identical:
                    region ops play for jerasure: the ground truth).
 - ``xla_ops``    — jit-compiled JAX paths built from XOR/shift chains
                    (no gathers; TPU- and CPU-safe).
-- ``pallas_gf``  — Pallas VMEM-resident SWAR kernels (the TPU
-                   performance path for w=8 matrix codes; dispatched
-                   by ``apply_matrix_best``).
+- ``pallas_gf``  — Pallas VMEM-resident kernels (the TPU performance
+                   path): SWAR GF(2^8) matrix apply and packet-layout
+                   bitmatrix apply, dispatched by ``apply_matrix_best``
+                   / ``apply_bitmatrix_best``.
 """
 
 from .regionops import (
@@ -23,7 +24,10 @@ from .xla_ops import (
     apply_bitmatrix_xla,
 )
 from .pallas_gf import (
+    apply_bitmatrix_best,
+    apply_bitmatrix_pallas,
     apply_matrix_best,
     apply_matrix_pallas,
+    pallas_bitmatrix_supported,
     pallas_matrix_supported,
 )

@@ -127,6 +127,82 @@ def apply_matrix_pallas(chunks: jax.Array, matrix_t,
     return out.reshape(lead + (r, c))
 
 
+def _bitmatrix_kernel(rows_masks, s: int, w: int, r: int, rt: int):
+    """Kernel body for a static (r*w, s*w) GF(2) bitmatrix in jerasure
+    packet layout: out packet (i, l) = XOR of in packets (j, lb) whose
+    bit is set.  Blocks carry one (s, w*rt, LANE) packet-group tile per
+    grid step; packet lb occupies sublane rows [lb*rt, (lb+1)*rt)."""
+
+    def kernel(in_ref, out_ref):
+        zero = None
+        for row_idx, mask in enumerate(rows_masks):
+            i, l = divmod(row_idx, w)
+            acc = None
+            col = 0
+            m = mask
+            while m:
+                if m & 1:
+                    j, lb = divmod(col, w)
+                    p = in_ref[0, j, 0, lb * rt:(lb + 1) * rt, :]
+                    acc = p if acc is None else acc ^ p
+                m >>= 1
+                col += 1
+            if acc is None:
+                if zero is None:
+                    zero = jnp.zeros((rt, LANE), jnp.uint32)
+                acc = zero
+            out_ref[0, i, 0, l * rt:(l + 1) * rt, :] = acc
+
+    return kernel
+
+
+def pallas_bitmatrix_supported(shape, w: int, packetsize: int) -> bool:
+    """w*packetsize-aligned chunks whose packets tile as uint32
+    (packetsize a multiple of 512 bytes = 128 lanes x 4)."""
+    if len(shape) < 2 or packetsize % (4 * LANE) != 0:
+        return False
+    c = shape[-1]
+    return c > 0 and c % (w * packetsize) == 0
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def apply_bitmatrix_pallas(chunks: jax.Array, bitmatrix_rows, w: int,
+                           packetsize: int,
+                           interpret: bool = False) -> jax.Array:
+    """Packet-layout bitmatrix apply on device, VMEM-resident — the
+    Pallas path for the bitmatrix techniques (cauchy_*, liberation,
+    blaum_roth, liber8tion, shec).  Same contract as
+    xla_ops.apply_bitmatrix_xla; caller gates on
+    pallas_bitmatrix_supported."""
+    s = chunks.shape[-2]
+    c = chunks.shape[-1]
+    rw = len(bitmatrix_rows)
+    r = rw // w
+    lead = chunks.shape[:-2]
+    b = int(np.prod(lead)) if lead else 1
+    nb = c // (w * packetsize)
+    rt = packetsize // (4 * LANE)      # uint32 rows per packet
+    words = jax.lax.bitcast_convert_type(
+        chunks.reshape(b, s, nb * w * packetsize // 4, 4), jnp.uint32)
+    words = words.reshape(b, s, nb, w * rt, LANE)
+    out = pl.pallas_call(
+        _bitmatrix_kernel(bitmatrix_rows, s, w, r, rt),
+        grid=(b, nb),
+        in_specs=[pl.BlockSpec((1, s, 1, w * rt, LANE),
+                               lambda i, j: (i, 0, j, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, r, 1, w * rt, LANE),
+                               lambda i, j: (i, 0, j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, r, nb, w * rt, LANE),
+                                       jnp.uint32),
+        interpret=interpret,
+    )(words)
+    out = jax.lax.bitcast_convert_type(
+        out.reshape(b, r, c // 4, 1), jnp.uint8)
+    return out.reshape(lead + (r, c))
+
+
 def _device_kind() -> str:
     try:
         return jax.default_backend()
@@ -149,3 +225,15 @@ def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
             and pallas_matrix_supported(chunks.shape, w)):
         return apply_matrix_pallas(chunks, matrix_t)
     return apply_matrix_xla(chunks, matrix_t, w)
+
+
+def apply_bitmatrix_best(chunks: jax.Array, bitmatrix_rows, w: int,
+                         packetsize: int) -> jax.Array:
+    """Dispatch for packet-layout bitmatrix codes: Pallas on TPU when
+    the packets tile, XLA otherwise.  Byte-identical either way."""
+    from .xla_ops import apply_bitmatrix_xla
+    if (use_pallas()
+            and pallas_bitmatrix_supported(chunks.shape, w, packetsize)):
+        return apply_bitmatrix_pallas(chunks, bitmatrix_rows, w,
+                                      packetsize)
+    return apply_bitmatrix_xla(chunks, bitmatrix_rows, w, packetsize)

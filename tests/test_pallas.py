@@ -66,3 +66,53 @@ def test_dispatcher_fallback_matches_on_cpu():
     ref = regionops.matrix_encode(data, matrix, 8)
     got = np.asarray(apply_matrix_best(data, matrix_to_static(matrix), 8))
     assert np.array_equal(got, ref)
+
+
+@pytest.mark.parametrize("k,m,w,ps,nb", [(4, 2, 8, 512, 2),
+                                         (8, 3, 8, 2048, 1),
+                                         (4, 2, 4, 512, 3),
+                                         (6, 3, 8, 512, 2)])
+def test_bitmatrix_pallas_matches_regionops(k, m, w, ps, nb):
+    from ceph_tpu.gf.bitmatrix import matrix_to_bitmatrix
+    from ceph_tpu.matrices.jerasure import (
+        cauchy_good_general_coding_matrix,
+    )
+    from ceph_tpu.ops.pallas_gf import (
+        apply_bitmatrix_pallas,
+        pallas_bitmatrix_supported,
+    )
+    from ceph_tpu.ops.xla_ops import bitmatrix_to_static
+    rng = np.random.default_rng(k * 100 + m)
+    bmat = matrix_to_bitmatrix(
+        k, m, w, cauchy_good_general_coding_matrix(k, m, w))
+    C = nb * w * ps
+    data = rng.integers(0, 256, (2, k, C), dtype=np.uint8)
+    assert pallas_bitmatrix_supported(data.shape, w, ps)
+    ref = regionops.bitmatrix_encode(data, bmat, w, ps)
+    got = np.asarray(apply_bitmatrix_pallas(
+        data, bitmatrix_to_static(bmat), w, ps, True))
+    assert np.array_equal(got, ref)
+
+
+def test_bitmatrix_supported_gate():
+    from ceph_tpu.ops.pallas_gf import pallas_bitmatrix_supported
+    assert pallas_bitmatrix_supported((4, 8 * 2048), 8, 2048)
+    assert not pallas_bitmatrix_supported((4, 8 * 8), 8, 8)  # tiny packets
+    assert not pallas_bitmatrix_supported((4, 1000), 8, 512)  # ragged
+
+
+def test_bitmatrix_dispatcher_fallback_on_cpu():
+    from ceph_tpu.gf.bitmatrix import matrix_to_bitmatrix
+    from ceph_tpu.matrices.jerasure import (
+        cauchy_good_general_coding_matrix,
+    )
+    from ceph_tpu.ops.pallas_gf import apply_bitmatrix_best
+    from ceph_tpu.ops.xla_ops import bitmatrix_to_static
+    rng = np.random.default_rng(9)
+    bmat = matrix_to_bitmatrix(
+        4, 2, 8, cauchy_good_general_coding_matrix(4, 2, 8))
+    data = rng.integers(0, 256, (2, 4, 8 * 512), dtype=np.uint8)
+    ref = regionops.bitmatrix_encode(data, bmat, 8, 512)
+    got = np.asarray(apply_bitmatrix_best(
+        data, bitmatrix_to_static(bmat), 8, 512))
+    assert np.array_equal(got, ref)
