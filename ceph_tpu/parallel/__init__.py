@@ -15,3 +15,4 @@ and lets XLA insert ICI/DCN collectives (SURVEY.md §2.3 parallelism map):
 
 from .mesh import make_mesh  # noqa: F401
 from .sharded_codes import sharded_encode, sharded_roundtrip_step  # noqa: F401
+from .sharded_crush import default_crush_mesh, sharded_bulk_do_rule  # noqa: F401

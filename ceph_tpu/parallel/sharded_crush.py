@@ -1,0 +1,73 @@
+"""Sharded bulk CRUSH evaluation over a device mesh.
+
+Placement evaluation is embarrassingly parallel over the input x (the
+pg seed) — SURVEY.md §2.3's "placement-evaluation parallelism" row — so
+the multi-chip form is pure data parallelism: the fused rule program
+(crush/bulk.py) is jit-compiled with the x batch sharded over the mesh
+and the compiled map tables replicated; XLA inserts no cross-chip
+collectives for the sweep itself (each chip evaluates its shard; only
+the caller-visible gather of results rides ICI).  This replaces the
+reference's fan-out of CrushTester work over CPU cores/daemons.
+
+Results remain bit-identical to the host mapper: lanes that exhaust
+the device try budget fall back to the exact host reference, same as
+the single-chip path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def sharded_bulk_do_rule(mesh: Mesh, cmap, ruleno: int, xs,
+                         result_max: int,
+                         weight: Optional[Sequence[int]] = None,
+                         bulk_tries: Optional[int] = None,
+                         choose_args: Optional[Dict] = None,
+                         axis: str = "x"):
+    """bulk_do_rule with the x sweep sharded over ``mesh`` axis
+    ``axis``.  Returns (results (N, result_max) int32, counts (N,))."""
+    from ..crush import bulk
+    from ..crush.mapper import crush_do_rule
+    from ..crush.types import CRUSH_ITEM_NONE
+
+    cm = (cmap if isinstance(cmap, bulk.CompiledCrushMap)
+          else bulk.CompiledCrushMap(cmap, choose_args))
+    if weight is None:
+        weight = cm.cmap.device_weights()
+    tries = bulk_tries if bulk_tries else bulk.DEFAULT_BULK_TRIES
+    fn = bulk.compile_rule(cm, ruleno, result_max, tries)
+    n_dev = mesh.shape[axis]
+    xs = np.asarray(xs, dtype=np.int64)
+    n = len(xs)
+    pad = (-n) % n_dev
+    xs_p = np.concatenate([xs, xs[:1].repeat(pad)]) if pad else xs
+
+    shard = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    jf = jax.jit(jax.vmap(fn, in_axes=(0, None)),
+                 in_shardings=(shard, repl),
+                 out_shardings=(shard, shard, shard))
+    wv = jnp.asarray(np.asarray(weight, dtype=np.int64))
+    out, cnt, need_host = jf(jnp.asarray(xs_p), wv)
+    out = np.asarray(out)[:n].copy()
+    cnt = np.asarray(cnt)[:n].copy()
+    for i in np.nonzero(np.asarray(need_host)[:n])[0]:
+        r = crush_do_rule(cm.cmap, ruleno, int(xs[i]), result_max,
+                          weight=list(weight),
+                          choose_args=cm.choose_args)
+        out[i] = r + [CRUSH_ITEM_NONE] * (result_max - len(r))
+        cnt[i] = len(r)
+    return out, cnt
+
+
+def default_crush_mesh(axis: str = "x") -> Mesh:
+    """All visible devices on one data-parallel axis."""
+    devs = np.array(jax.devices())
+    return Mesh(devs, (axis,))

@@ -36,3 +36,63 @@ def test_sharded_roundtrip():
     decoded, parity = sharded_roundtrip_step(mesh, data, m=3)
     assert np.array_equal(np.asarray(decoded), data)
     assert parity.shape == (4, 3, 512)
+
+
+def test_sharded_bulk_crush_matches_host():
+    """The x sweep sharded over an 8-device mesh is bit-identical to
+    the host mapper (and to the single-chip bulk path)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from ceph_tpu.crush import CrushBuilder, crush_do_rule
+    from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+    from ceph_tpu.parallel.sharded_crush import sharded_bulk_do_rule
+
+    b = CrushBuilder()
+    root = b.build_two_level(5, 3)
+    b.add_simple_rule(0, root, "host", firstn=True)
+    b.add_simple_rule(1, root, "host", firstn=False)
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    for ruleno in (0, 1):
+        out, cnt = sharded_bulk_do_rule(mesh, b.map, ruleno,
+                                        np.arange(301), 3)  # odd N: pad
+        assert out.shape == (301, 3)
+        for x in range(301):
+            ref = crush_do_rule(b.map, ruleno, x, 3)
+            ref = ref + [CRUSH_ITEM_NONE] * (3 - len(ref))
+            assert list(out[x]) == ref, (ruleno, x)
+
+
+def test_sharded_bulk_crush_chained_and_choose_args():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from ceph_tpu.crush import (CrushBuilder, crush_do_rule,
+                                step_choose_indep, step_chooseleaf_indep,
+                                step_emit, step_take)
+    from ceph_tpu.crush.types import CRUSH_ITEM_NONE, ChooseArg
+    from ceph_tpu.parallel.sharded_crush import sharded_bulk_do_rule
+
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "rack")
+    b.add_type(3, "root")
+    racks = []
+    d = 0
+    for _ in range(3):
+        hosts = []
+        for _ in range(2):
+            hosts.append(b.add_bucket("straw2", "host", [d, d + 1]))
+            d += 2
+        racks.append(b.add_bucket("straw2", "rack", hosts))
+    root = b.add_bucket("straw2", "root", racks)
+    b.add_rule(0, [step_take(root), step_choose_indep(0, 2),
+                   step_chooseleaf_indep(1, 1), step_emit()])
+    args = {root: ChooseArg(weight_set=[[0x8000, 0x20000, 0x10000]])}
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    out, cnt = sharded_bulk_do_rule(mesh, b.map, 0, np.arange(160), 3,
+                                    choose_args=args)
+    for x in range(160):
+        ref = crush_do_rule(b.map, 0, x, 3, choose_args=args)
+        ref = ref + [CRUSH_ITEM_NONE] * (3 - len(ref))
+        assert list(out[x]) == ref, x
