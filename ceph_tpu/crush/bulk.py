@@ -19,9 +19,10 @@ dense arrays and `crush_do_rule` becomes one fused jit program:
   exactly on the host reference mapper, so results are ALWAYS
   bit-identical to mapper.py / the C semantics, at any budget.
 
-Scope: straw2 buckets (the modern default; uniform/list/tree/straw maps
-run on the host mapper — bucket_perm_choose is stateful by design) and
-jewel tunables (choose_local_* == 0).  Equivalence is pinned by
+Scope: straw2, legacy straw, and list buckets fuse (alg-dispatched per
+bucket row; pure-straw2 maps compile no extra branches); uniform
+(stateful bucket_perm_choose) and tree walks run on the host mapper.
+Jewel tunables (choose_local_* == 0).  Equivalence is pinned by
 tests/test_crush_bulk.py over randomized maps, rules and reweights.
 
 int64: crush_ln is 16.48 fixed point, so this module enables
@@ -41,10 +42,12 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp
 
-from .hash import crush_hash32_2, crush_hash32_3
+from .hash import crush_hash32_2, crush_hash32_3, crush_hash32_4
 from .ln import crush_ln
 from .mapper import crush_do_rule
 from .types import (
+    CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_STRAW,
     CRUSH_BUCKET_STRAW2,
     CRUSH_ITEM_NONE,
     ChooseArg,
@@ -83,10 +86,12 @@ class CompiledCrushMap:
                  choose_args: Optional[Dict[int, "ChooseArg"]] = None
                  ) -> None:
         for b in cmap.buckets.values():
-            if b.alg != CRUSH_BUCKET_STRAW2:
+            if b.alg not in (CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_STRAW,
+                             CRUSH_BUCKET_LIST):
                 raise ValueError(
-                    "bulk evaluator supports straw2 maps; use the host "
-                    f"mapper for bucket alg {b.alg}")
+                    "bulk evaluator supports straw2/straw/list maps "
+                    "(uniform perm state and tree walks run on the host "
+                    f"mapper); bucket alg {b.alg} is not fused")
         self.cmap = cmap
         self.choose_args = choose_args
         ids = sorted(cmap.buckets)          # negative ids
@@ -104,6 +109,11 @@ class CompiledCrushMap:
         pos_weights = np.zeros((self.n_buckets, P, S), np.int64)
         types = np.zeros(self.n_buckets, np.int32)
         sizes = np.zeros(self.n_buckets, np.int32)
+        algs = np.zeros(self.n_buckets, np.int32)
+        bids = np.zeros(self.n_buckets, np.int32)
+        straws = np.zeros((self.n_buckets, S), np.int64)
+        sum_weights = np.zeros((self.n_buckets, S), np.int64)
+        raw_weights = np.zeros((self.n_buckets, S), np.int64)
         for bid, row in self.row_of_id.items():
             b = cmap.buckets[bid]
             items[row, :b.size] = b.items
@@ -111,6 +121,13 @@ class CompiledCrushMap:
             pos_weights[row, :, :b.size] = b.item_weights
             types[row] = b.type
             sizes[row] = b.size
+            algs[row] = b.alg
+            bids[row] = bid
+            raw_weights[row, :b.size] = b.item_weights
+            if b.alg == CRUSH_BUCKET_STRAW:
+                straws[row, :b.size] = b.straws
+            if b.alg == CRUSH_BUCKET_LIST:
+                sum_weights[row, :b.size] = b.sum_weights
             arg = choose_args.get(bid) if choose_args else None
             if arg is not None:
                 if arg.ids:
@@ -120,6 +137,7 @@ class CompiledCrushMap:
                     for p in range(P):
                         pos_weights[row, p, :b.size] = \
                             ws[min(p, len(ws) - 1)][:b.size]
+        self.algs_present = sorted(set(int(a) for a in algs))
         max_neg = max((-bid for bid in ids), default=0)
         i2r = np.full(max_neg + 1, 0, np.int32)
         for bid, row in self.row_of_id.items():
@@ -129,6 +147,11 @@ class CompiledCrushMap:
         self.pos_weights = jnp.asarray(pos_weights)
         self.types = jnp.asarray(types)
         self.sizes = jnp.asarray(sizes)
+        self.algs = jnp.asarray(algs)
+        self.bucket_ids = jnp.asarray(bids)
+        self.straws = jnp.asarray(straws)
+        self.sum_weights = jnp.asarray(sum_weights)
+        self.raw_weights = jnp.asarray(raw_weights)
         self.id_to_row = jnp.asarray(i2r)
         self.negln = jnp.asarray(_NEGLN)
         self.max_depth = self._depth(cmap)
@@ -219,6 +242,62 @@ def _straw2(cm: CompiledCrushMap, row, x, r, pos=0):
         items, jnp.argmax(draw, axis=-1)[..., None], axis=-1)[..., 0]
 
 
+def _straw_legacy(cm: CompiledCrushMap, row, x, r):
+    """mapper.c -> bucket_straw_choose (legacy straw): draw =
+    (hash32_3 & 0xffff) * straw, argmax first-wins.  choose_args do not
+    apply to legacy straw (crush_bucket_choose passes them to straw2
+    only)."""
+    items = cm.items[row]
+    valid = jnp.arange(cm.max_size) < cm.sizes[row][..., None]
+    u = crush_hash32_3(
+        jnp.asarray(x, jnp.uint32),
+        items.astype(jnp.uint32),
+        jnp.asarray(r, jnp.uint32)[..., None]).astype(jnp.int64) & 0xFFFF
+    draw = jnp.where(valid, u * cm.straws[row], -1)
+    return jnp.take_along_axis(
+        items, jnp.argmax(draw, axis=-1)[..., None], axis=-1)[..., 0]
+
+
+def _list_choose(cm: CompiledCrushMap, row, x, r):
+    """mapper.c -> bucket_list_choose: scan items from the tail; the
+    first i with (hash32_4(x, item, r, bucket_id) & 0xffff) *
+    sum_weights[i] >> 16 < item_weight[i] wins, else items[0]."""
+    items = cm.items[row]
+    valid = jnp.arange(cm.max_size) < cm.sizes[row][..., None]
+    h = crush_hash32_4(
+        jnp.asarray(x, jnp.uint32),
+        items.astype(jnp.uint32),
+        jnp.asarray(r, jnp.uint32)[..., None],
+        cm.bucket_ids[row].astype(jnp.uint32)[..., None]
+    ).astype(jnp.int64) & 0xFFFF
+    t = (h * cm.sum_weights[row]) >> 16
+    cond = valid & (t < cm.raw_weights[row])
+    # highest index with cond true (the C loop runs size-1 .. 0)
+    rank = jnp.where(cond, jnp.arange(cm.max_size), -1)
+    best = jnp.argmax(rank, axis=-1)
+    found = jnp.any(cond, axis=-1)
+    chosen = jnp.take_along_axis(items, best[..., None], axis=-1)[..., 0]
+    return jnp.where(found, chosen, items[..., 0])
+
+
+def _bucket_choose(cm: CompiledCrushMap, row, x, r, pos=0):
+    """mapper.c -> crush_bucket_choose over the fused algorithms;
+    branches compile only for algorithms present in the map (pure
+    straw2 maps pay nothing extra)."""
+    res = None
+    if CRUSH_BUCKET_STRAW2 in cm.algs_present:
+        res = _straw2(cm, row, x, r, pos)
+    if CRUSH_BUCKET_STRAW in cm.algs_present:
+        s = _straw_legacy(cm, row, x, r)
+        res = s if res is None else jnp.where(
+            cm.algs[row] == CRUSH_BUCKET_STRAW, s, res)
+    if CRUSH_BUCKET_LIST in cm.algs_present:
+        lc = _list_choose(cm, row, x, r)
+        res = lc if res is None else jnp.where(
+            cm.algs[row] == CRUSH_BUCKET_LIST, lc, res)
+    return res
+
+
 def _descend(cm: CompiledCrushMap, start_item, x, r, target_type,
              steps: Optional[int] = None, pos=0):
     """Walk from start_item down to an item of target_type (mapper.c
@@ -235,7 +314,7 @@ def _descend(cm: CompiledCrushMap, start_item, x, r, target_type,
         row = jnp.where(is_bucket, cm.row(item), 0)
         itype = jnp.where(is_bucket, cm.types[row], 0)
         arrived = itype == target_type
-        picked = _straw2(cm, row, x, r, pos)
+        picked = _bucket_choose(cm, row, x, r, pos)
         nxt = jnp.where(done | arrived | ~is_bucket, item, picked)
         done = done | arrived | (~is_bucket)
         item = nxt

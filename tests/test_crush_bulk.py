@@ -307,3 +307,55 @@ def test_bulk_chained_overload_holes():
     b, root = build3level(2, 2, 2)
     b.add_rule(0, CHAIN_STEPS["indep_chain"](root))
     pin(b, 0, 4, N=200)
+
+
+@pytest.mark.parametrize("alg", ["straw", "list"])
+def test_bulk_matches_host_legacy_algs(alg):
+    """Legacy straw and list buckets run fused now (tree/uniform stay
+    host-gated); pinned bit-for-bit vs the host mapper."""
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    hosts = []
+    for h in range(4):
+        ws = [0x8000 + 0x5000 * ((h + i) % 3) for i in range(3)]
+        hosts.append(b.add_bucket(alg, "host",
+                                  list(range(h * 3, h * 3 + 3)), ws))
+    root = b.add_bucket(alg, "root", hosts)
+    b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+    b.add_rule(1, STEPS["chooseleaf_indep"](root))
+    pin(b, 0, 3, N=300)
+    pin(b, 1, 3, N=300)
+
+
+def test_bulk_matches_host_mixed_algs():
+    """straw2 root over straw and list hosts in one map."""
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    h0 = b.add_bucket("straw", "host", [0, 1, 2],
+                      [0x10000, 0x18000, 0x8000])
+    h1 = b.add_bucket("list", "host", [3, 4], [0x10000, 0x20000])
+    h2 = b.add_bucket("straw2", "host", [5, 6, 7],
+                      [0x10000, 0x10000, 0x18000])
+    root = b.add_bucket("straw2", "root", [h0, h1, h2])
+    b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+    pin(b, 0, 3, N=300)
+    w = b.map.device_weights()
+    w[3] = 0x4000
+    pin(b, 0, 3, N=200, weight=w)
+
+
+def test_bulk_gates_tree_and_uniform():
+    for alg in ("tree", "uniform"):
+        b = CrushBuilder()
+        b.add_type(1, "host")
+        b.add_type(2, "root")
+        ws = [0x10000] * 3
+        h0 = b.add_bucket(alg, "host", [0, 1, 2], ws)
+        h1 = b.add_bucket(alg, "host", [3, 4, 5], ws)
+        root = b.add_bucket(alg, "root", [h0, h1], [0x30000, 0x30000])
+        b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+        with pytest.raises(ValueError, match="not fused"):
+            bulk.bulk_do_rule(b.map, 0, np.arange(4), 2)
+        assert crush_do_rule(b.map, 0, 0, 2)  # host handles them
