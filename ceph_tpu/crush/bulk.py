@@ -148,10 +148,14 @@ class CompiledCrushMap:
         self.types = jnp.asarray(types)
         self.sizes = jnp.asarray(sizes)
         self.algs = jnp.asarray(algs)
-        self.bucket_ids = jnp.asarray(bids)
-        self.straws = jnp.asarray(straws)
-        self.sum_weights = jnp.asarray(sum_weights)
-        self.raw_weights = jnp.asarray(raw_weights)
+        # legacy-alg tables upload only when those algorithms exist in
+        # the map (pure-straw2 maps allocate none of them)
+        has_straw = CRUSH_BUCKET_STRAW in self.algs_present
+        has_list = CRUSH_BUCKET_LIST in self.algs_present
+        self.straws = jnp.asarray(straws) if has_straw else None
+        self.bucket_ids = jnp.asarray(bids) if has_list else None
+        self.sum_weights = jnp.asarray(sum_weights) if has_list else None
+        self.raw_weights = jnp.asarray(raw_weights) if has_list else None
         self.id_to_row = jnp.asarray(i2r)
         self.negln = jnp.asarray(_NEGLN)
         self.max_depth = self._depth(cmap)
