@@ -4,20 +4,30 @@ SURVEY.md §7 step 3 (north star: "GF(2^8) Reed-Solomon / Cauchy matrix
 multiplies as Pallas bit-sliced kernels").  Replaces, at the math level,
 gf-complete's SIMD region ops (src/erasure-code/jerasure/gf-complete ->
 gf_w8_split_multiply_region_sse family) with a VMEM-resident SWAR
-kernel:
+kernel.
 
-- Bytes stay SWAR-packed, 4 independent GF(2^8) field bytes per uint32
-  VPU lane (TPUs have no byte gather; 32-bit lanes are native).
-- Each grid step holds one (k, TILE) tile of the stripe batch in VMEM,
-  computes the xtime doubling planes x^t * chunk_j in registers, and
-  XOR-folds them straight into the m parity accumulators — data is read
-  from HBM once and parity written once, with NO intermediate plane
-  materialization.  (The XLA fallback in xla_ops.py expresses the same
-  math, but at multi-MiB batch sizes XLA materializes doubling planes
-  between fusions, which caps it far below HBM bandwidth.)
+Layout (measured on a v5e through profile_encode3.py): kernel I/O is
+uint8 END TO END.  An HBM-side uint8<->uint32 bitcast around the kernel
+is a full relayout (u8 tiles are (32,128), u32 tiles (8,128)) costing
+~3x the kernel itself; instead each block loads u8 tiles and packs four
+sublanes into one u32 SWAR word IN REGISTERS (pltpu.bitcast), runs the
+xtime/XOR schedule, and unpacks on store.  The byte->word mapping is
+private to the kernel and symmetric on input and output, and GF(2^8)
+region math is byte-local, so any fixed bijection is exact.
+
+- 4 independent GF(2^8) field bytes per uint32 VPU lane (TPUs have no
+  byte gather; 32-bit lanes are native).
+- Each grid step holds one (k, TILE) tile of the stripe batch in VMEM
+  and XOR-folds xtime doubling planes straight into the m parity
+  accumulators — data is read from HBM once and parity written once.
+  (The XLA fallback in xla_ops.py expresses the same math, but
+  materializes doubling planes between fusions at multi-MiB sizes.)
 - The coding matrix is STATIC: the kernel is specialized (fully
   unrolled xtime/XOR schedule) per matrix, like jerasure's
   smart-schedule specialization per bitmatrix.
+- Bitmatrix codes (cauchy_*, liberation, blaum_roth, liber8tion, shec)
+  are pure packet XOR — no word packing at all; their kernel stays in
+  uint8 throughout.
 
 Byte-identity: pinned against ops/regionops.py (the host ground truth)
 in tests/test_pallas.py, in interpreter mode on CPU and compiled on TPU.
@@ -37,11 +47,35 @@ from jax.experimental.pallas import tpu as pltpu
 # engines can never diverge
 from .xla_ops import xtime_swar8 as _xtime_swar
 
-LANE = 128          # TPU lane width
-MAX_ROW_TILE = 64   # uint32 rows of 128 lanes per block: 32 KiB per chunk
+LANE = 128            # TPU lane width
+SUBLANE_U8 = 32       # uint8 VMEM tile is (32, 128)
+MAX_ROW_TILE8 = 512   # u8 rows of 128 lanes per block: 64 KiB per chunk
 
 
-def _gf8_matrix_kernel(matrix_t, s: int, r: int):
+def _pack_words(tile, interpret: bool):
+    """(4r, 128) uint8 tile -> (r, 128) uint32 SWAR words, in registers.
+
+    On TPU this is a vreg reinterpret (pltpu.bitcast packs 4 sublanes
+    per 32-bit sublane); the interpreter path emulates one fixed
+    mapping.  Only symmetry with _unpack_words matters (see module
+    docstring)."""
+    if not interpret:
+        return pltpu.bitcast(tile, jnp.uint32)
+    r = tile.shape[0] // 4
+    b = tile.reshape(r, 4, LANE).astype(jnp.uint32)
+    return b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+
+
+def _unpack_words(words, interpret: bool):
+    """Inverse of _pack_words: (r, 128) uint32 -> (4r, 128) uint8."""
+    if not interpret:
+        return pltpu.bitcast(words, jnp.uint8)
+    parts = jnp.stack([(words >> s) & 0xFF for s in (0, 8, 16, 24)],
+                      axis=1)
+    return parts.astype(jnp.uint8).reshape(words.shape[0] * 4, LANE)
+
+
+def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool):
     """Build the specialized kernel body for a static (r, s) GF(2^8)
     matrix: per input chunk j, walk the xtime doubling chain once and
     XOR plane t into every accumulator i whose matrix[i][j] has bit t."""
@@ -53,7 +87,7 @@ def _gf8_matrix_kernel(matrix_t, s: int, r: int):
             top = max((c.bit_length() for c in col), default=0)
             if top == 0:
                 continue
-            plane = in_ref[0, j]
+            plane = _pack_words(in_ref[0, j], interpret)
             for t in range(top):
                 if t > 0:
                     plane = _xtime_swar(plane)
@@ -65,17 +99,18 @@ def _gf8_matrix_kernel(matrix_t, s: int, r: int):
             if accs[i] is None:
                 if zero is None:
                     zero = jnp.zeros_like(in_ref[0, 0])
-                accs[i] = zero
-            out_ref[0, i] = accs[i]
+                out_ref[0, i] = zero
+            else:
+                out_ref[0, i] = _unpack_words(accs[i], interpret)
 
     return kernel
 
 
-def _row_tile(rows: int) -> int:
-    """Largest multiple of 8 that divides ``rows``, capped at 64 (the
-    (8, 128) int32 VMEM tile requires multiple-of-8 sublane blocks);
-    0 when no such divisor exists (caller falls back to XLA)."""
-    for cand in range(MAX_ROW_TILE, 7, -8):
+def _row_tile8(rows: int) -> int:
+    """Largest multiple of 32 (the u8 VMEM tile sublane count) that
+    divides ``rows``, capped at MAX_ROW_TILE8; 0 when none exists
+    (caller falls back to XLA)."""
+    for cand in range(MAX_ROW_TILE8, SUBLANE_U8 - 1, -SUBLANE_U8):
         if cand <= rows and rows % cand == 0:
             return cand
     return 0
@@ -83,14 +118,14 @@ def _row_tile(rows: int) -> int:
 
 def pallas_matrix_supported(shape, w: int) -> bool:
     """True when (..., s, C) uint8 chunks fit the kernel's tiling: w=8
-    and C a multiple of 4*128*8 words (every SIMD-aligned chunk size
+    and C a multiple of 32*128 bytes (every SIMD-aligned chunk size
     >= 4 KiB qualifies; others fall back to the XLA path)."""
     if w != 8 or len(shape) < 2:
         return False
     c = shape[-1]
-    if c % (4 * LANE) != 0:
+    if c % LANE != 0:
         return False
-    return _row_tile(c // (4 * LANE)) != 0
+    return _row_tile8(c // LANE) != 0
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
@@ -105,14 +140,12 @@ def apply_matrix_pallas(chunks: jax.Array, matrix_t,
     assert chunks.shape[-2] == s and chunks.dtype == jnp.uint8
     lead = chunks.shape[:-2]
     c = chunks.shape[-1]
-    c4 = c // 4
-    rows = c4 // LANE
-    rt = _row_tile(rows)
+    rows = c // LANE
+    rt = _row_tile8(rows)
     b = int(np.prod(lead)) if lead else 1
-    words = jax.lax.bitcast_convert_type(
-        chunks.reshape(b, s, c4, 4), jnp.uint32).reshape(b, s, rows, LANE)
+    tiles = chunks.reshape(b, s, rows, LANE)
     out = pl.pallas_call(
-        _gf8_matrix_kernel(matrix_t, s, r),
+        _gf8_matrix_kernel(matrix_t, s, r, interpret),
         grid=(b, rows // rt),
         in_specs=[pl.BlockSpec((1, s, rt, LANE),
                                lambda i, j: (i, 0, j, 0),
@@ -120,18 +153,18 @@ def apply_matrix_pallas(chunks: jax.Array, matrix_t,
         out_specs=pl.BlockSpec((1, r, rt, LANE),
                                lambda i, j: (i, 0, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, r, rows, LANE), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((b, r, rows, LANE), jnp.uint8),
         interpret=interpret,
-    )(words)
-    out = jax.lax.bitcast_convert_type(out.reshape(b, r, c4, 1), jnp.uint8)
+    )(tiles)
     return out.reshape(lead + (r, c))
 
 
 def _bitmatrix_kernel(rows_masks, s: int, w: int, r: int, rt: int):
     """Kernel body for a static (r*w, s*w) GF(2) bitmatrix in jerasure
     packet layout: out packet (i, l) = XOR of in packets (j, lb) whose
-    bit is set.  Blocks carry one (s, w*rt, LANE) packet-group tile per
-    grid step; packet lb occupies sublane rows [lb*rt, (lb+1)*rt)."""
+    bit is set.  Pure uint8 XOR — no word packing needed.  Blocks carry
+    one (s, w*rt, LANE) packet-group tile per grid step; packet lb
+    occupies sublane rows [lb*rt, (lb+1)*rt)."""
 
     def kernel(in_ref, out_ref):
         zero = None
@@ -149,7 +182,7 @@ def _bitmatrix_kernel(rows_masks, s: int, w: int, r: int, rt: int):
                 col += 1
             if acc is None:
                 if zero is None:
-                    zero = jnp.zeros((rt, LANE), jnp.uint32)
+                    zero = jnp.zeros((rt, LANE), jnp.uint8)
                 acc = zero
             out_ref[0, i, 0, l * rt:(l + 1) * rt, :] = acc
 
@@ -157,8 +190,9 @@ def _bitmatrix_kernel(rows_masks, s: int, w: int, r: int, rt: int):
 
 
 def pallas_bitmatrix_supported(shape, w: int, packetsize: int) -> bool:
-    """w*packetsize-aligned chunks whose packets tile as uint32
-    (packetsize a multiple of 512 bytes = 128 lanes x 4)."""
+    """w*packetsize-aligned chunks whose packets span >= 4 uint8
+    sublane rows (packetsize a multiple of 512 bytes, the gate the
+    tests pin; smaller packets fall back to the XLA path)."""
     if len(shape) < 2 or packetsize % (4 * LANE) != 0:
         return False
     c = shape[-1]
@@ -181,10 +215,8 @@ def apply_bitmatrix_pallas(chunks: jax.Array, bitmatrix_rows, w: int,
     lead = chunks.shape[:-2]
     b = int(np.prod(lead)) if lead else 1
     nb = c // (w * packetsize)
-    rt = packetsize // (4 * LANE)      # uint32 rows per packet
-    words = jax.lax.bitcast_convert_type(
-        chunks.reshape(b, s, nb * w * packetsize // 4, 4), jnp.uint32)
-    words = words.reshape(b, s, nb, w * rt, LANE)
+    rt = packetsize // LANE            # u8 rows per packet
+    tiles = chunks.reshape(b, s, nb, w * rt, LANE)
     out = pl.pallas_call(
         _bitmatrix_kernel(bitmatrix_rows, s, w, r, rt),
         grid=(b, nb),
@@ -195,11 +227,9 @@ def apply_bitmatrix_pallas(chunks: jax.Array, bitmatrix_rows, w: int,
                                lambda i, j: (i, 0, j, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, r, nb, w * rt, LANE),
-                                       jnp.uint32),
+                                       jnp.uint8),
         interpret=interpret,
-    )(words)
-    out = jax.lax.bitcast_convert_type(
-        out.reshape(b, r, c // 4, 1), jnp.uint8)
+    )(tiles)
     return out.reshape(lead + (r, c))
 
 
