@@ -8,6 +8,9 @@
                    (src/erasure-code/ErasureCodePlugin.{h,cc}).
 - ``plugins/``   — jerasure, isa, shec, clay, lrc, example equivalents,
                    each TPU-native (JAX/XLA/Pallas compute paths).
+- ``stripe``     — ECUtil analog: stripe_info_t geometry, batched
+                   whole-object encode/decode, crc32c HashInfo
+                   (src/osd/ECUtil.{h,cc}).
 """
 
 from .interface import ErasureCodeInterface, ErasureCodeProfile

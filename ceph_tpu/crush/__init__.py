@@ -17,6 +17,11 @@ mapper.c, CrushWrapper.{h,cc}, CrushTester.{h,cc}):
   text grammar, and binary (CrushWrapper::encode/decode wire form)
   compile/decompile; real cluster maps (text or `ceph osd getcrushmap`
   blobs) drive the evaluators directly.
+- ``osdmap``  — the pg → OSD pipeline above CRUSH (OSDMap::
+  pg_to_up_acting_osds: pps seeds, upmap overrides, primary affinity,
+  pg/primary temp), scalar + whole-pool bulk paths.
+- ``balancer`` — OSDMap::calc_pg_upmaps analog: upmap balancing scored
+  by the bulk evaluator.
 """
 
 from .types import (  # noqa: F401

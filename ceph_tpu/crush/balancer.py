@@ -1,0 +1,168 @@
+"""upmap balancer — OSDMap::calc_pg_upmaps analog over the bulk
+evaluator.
+
+Reference: src/osd/OSDMap.cc → OSDMap::calc_pg_upmaps (the mgr
+balancer module's upmap mode, src/pybind/mgr/balancer/module.py, calls
+this): iteratively move pg replicas from the most-overfull osd to the
+most-underfull osd via pg_upmap_items entries, subject to the CRUSH
+rule's failure-domain constraint, until per-osd deviation from the
+weight-proportional target is within ``max_deviation``.
+
+TPU-first: each iteration's cluster-wide placement scan — the expensive
+part upstream (pg_num × do_rule) — is ONE bulk evaluator call
+(OSDMap.pg_to_up_bulk); candidate moves are then validated against the
+sparse up-sets on the host.  This is the "balancer-style bulk remap
+scoring" consumer the bulk path exists for.
+
+Simplifications vs upstream, by design: deviation is computed per pool
+(upstream aggregates over overlapping pools); candidate selection is
+first-fit over the overfull osd's pgs (upstream shuffles); no
+stddev-improvement early-exit heuristics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .osdmap import OSDMap
+from .types import (
+    CRUSH_ITEM_NONE,
+    CRUSH_RULE_CHOOSELEAF_FIRSTN,
+    CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_CHOOSE_FIRSTN,
+    CRUSH_RULE_CHOOSE_INDEP,
+    CrushMap,
+)
+
+
+def parent_map(cmap: CrushMap) -> Dict[int, int]:
+    """child item -> containing bucket id, one O(buckets) pass."""
+    parents: Dict[int, int] = {}
+    for bid, b in cmap.buckets.items():
+        for item in b.items:
+            parents[item] = bid
+    return parents
+
+
+def ancestor_of_type(cmap: CrushMap, item: int, type_id: int,
+                     parents: Optional[Dict[int, int]] = None
+                     ) -> Optional[int]:
+    """Walk up the hierarchy to the ancestor bucket of ``type_id``
+    (CrushWrapper::get_parent_of_type).  Pass a precomputed
+    ``parent_map(cmap)`` when calling in a loop."""
+    if parents is None:
+        parents = parent_map(cmap)
+    cur: Optional[int] = item
+    while cur is not None:
+        if cur < 0 and cmap.buckets[cur].type == type_id:
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def rule_failure_domain(cmap: CrushMap, ruleno: int) -> int:
+    """The choose type of the rule's (first) choose step — the level
+    replicas must not share (0 = osd, i.e. no constraint)."""
+    for op, _, arg2 in cmap.rules[ruleno].steps:
+        if op in (CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP,
+                  CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSE_INDEP):
+            if arg2 != 0:
+                return arg2
+    return 0
+
+
+def osd_crush_weights(cmap: CrushMap) -> np.ndarray:
+    """Per-osd 16.16 crush weight (leaf weights summed over the tree —
+    an osd referenced from several buckets counts once per reference,
+    like get_rule_weight_osd_map's flattening)."""
+    w = np.zeros(cmap.max_devices, dtype=np.float64)
+    seen = set()
+    for bid, b in cmap.buckets.items():
+        if cmap.shadow_of(bid):
+            continue  # shadow trees duplicate the device leaves
+        for item, iw in zip(b.items, b.item_weights):
+            if item >= 0 and (bid, item) not in seen:
+                seen.add((bid, item))
+                w[item] += iw
+    return w
+
+
+def calc_pg_upmaps(m: OSDMap, pool_id: int, max_deviation: float = 1.0,
+                   max_iterations: int = 100, engine: str = "bulk"
+                   ) -> Dict[Tuple[int, int], List[Tuple[int, int]]]:
+    """Propose (and apply to ``m``) pg_upmap_items entries flattening
+    the pool's per-osd replica counts.  Returns the new entries.
+
+    Done when every osd's count is within ``max_deviation`` of its
+    weight-proportional target (OSDMap::calc_pg_upmaps' loop condition)
+    or no further legal move exists."""
+    pool = m.pools[pool_id]
+    fd_type = rule_failure_domain(m.crush, pool.crush_rule)
+    weights = osd_crush_weights(m.crush)
+    # out osds take no replicas and no target share
+    for o in range(m.max_osd):
+        if m.is_out(o) or not m.is_up(o):
+            weights[o] = 0.0
+    if weights.sum() == 0:
+        return {}
+
+    # osd -> failure-domain ancestor, precomputed once (the inner loop
+    # otherwise re-walks the hierarchy per (pg, candidate) pair)
+    parents = parent_map(m.crush)
+    fd_of = {o: ancestor_of_type(m.crush, o, fd_type, parents)
+             for o in range(m.max_osd)} if fd_type else {}
+
+    changes: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
+    for _ in range(max_iterations):
+        up, _ = m.pg_to_up_bulk(pool_id, engine=engine)
+        flat = up.ravel()
+        placed = flat[(flat != CRUSH_ITEM_NONE) & (flat >= 0)]
+        counts = np.bincount(placed, minlength=m.max_osd).astype(np.float64)
+        target = weights / weights.sum() * len(placed)
+        dev = counts - target
+        # ignore osds that can't take/give replicas
+        dev[weights == 0] = 0.0
+        if dev.max() <= max_deviation and dev.min() >= -max_deviation:
+            break
+        over = int(np.argmax(dev))
+        move = _find_move(m, pool, up, over, dev, fd_type, fd_of)
+        if move is None:
+            break
+        ps, under = move
+        key = (pool_id, pool.raw_pg_to_pg(ps))
+        entry = m.pg_upmap_items.setdefault(key, [])
+        entry.append((over, under))
+        changes[key] = list(entry)
+    return changes
+
+
+def _find_move(m: OSDMap, pool, up: np.ndarray, over: int,
+               dev: np.ndarray, fd_type: int,
+               fd_of: Dict[int, Optional[int]]
+               ) -> Optional[Tuple[int, int]]:
+    """First pg on the overfull osd that can legally shed a replica to
+    the most-underfull compatible osd: target not already in the pg,
+    and in a failure domain distinct from the remaining replicas'."""
+    order = np.argsort(dev)             # most underfull first
+    for ps in range(pool.pg_num):
+        members = [int(o) for o in up[ps] if o != CRUSH_ITEM_NONE]
+        if over not in members:
+            continue
+        key = (pool.pool_id, pool.raw_pg_to_pg(ps))
+        if any(f == over or t == over
+               for f, t in m.pg_upmap_items.get(key, [])):
+            continue                    # don't stack moves on one pg
+        others = [o for o in members if o != over]
+        other_domains = {fd_of[o] for o in others} if fd_type else set()
+        for under in order:
+            under = int(under)
+            if dev[under] >= -1e-9 or under == over:
+                break                   # nothing meaningfully underfull
+            if under in members or not m.is_up(under) or m.is_out(under):
+                continue
+            if fd_type and fd_of[under] in other_domains:
+                continue                # would double up a failure domain
+            return ps, under
+    return None

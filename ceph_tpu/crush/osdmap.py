@@ -1,0 +1,386 @@
+"""OSDMap analog — the pg → OSD placement pipeline above CRUSH.
+
+Reference: src/osd/OSDMap.{h,cc} → OSDMap::pg_to_up_acting_osds =
+_pg_to_raw_osds (pps seed from pg_pool_t::raw_pg_to_pps, then
+crush->do_rule) → _apply_upmap (pg-upmap / pg-upmap-items) →
+_raw_to_up_osds → _apply_primary_affinity → pg_temp / primary_temp
+(SURVEY.md §3.4); src/osd/osd_types.{h,cc} → pg_t, pg_pool_t
+(raw_pg_to_pg / raw_pg_to_pps / calc_pg_masks), ceph_stable_mod.
+
+TPU-first addition: ``pg_to_up_bulk`` evaluates EVERY pg of a pool in
+one call — pps seeds vectorized (numpy rjenkins), raw placements through
+the fused device evaluator (crush/bulk.py), then the sparse override
+layers (upmap, temp) applied host-side where they live naturally (they
+are small dicts).  This is the balancer's inner loop: score a whole
+cluster remap in one shot instead of `pg_num` serial do_rule calls.
+
+Simplifications vs upstream, by design:
+- osd state is (exists, up, weight, primary_affinity) flat lists; there
+  is no epoch/incremental machinery (no mon here).
+- pg ids are (pool_id, ps) tuples, not the full pg_t wire struct.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .hash import crush_hash32_2
+from .mapper import crush_do_rule
+from .types import CRUSH_ITEM_NONE, CrushMap, RULE_TYPE_REPLICATED
+
+# osd_types.h → CEPH_OSD_MAX_PRIMARY_AFFINITY / DEFAULT (16.16 unit)
+MAX_PRIMARY_AFFINITY = 0x10000
+IN_WEIGHT = 0x10000
+
+
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    """include/rados.h → ceph_stable_mod: mod that remains stable as b
+    grows through non-powers-of-two (pg splitting)."""
+    if (x & bmask) < b:
+        return x & bmask
+    return x & (bmask >> 1)
+
+
+def pg_mask(n: int) -> int:
+    """osd_types.cc → pg_pool_t::calc_pg_masks: smallest 2^k-1 >= n-1."""
+    if n <= 1:
+        return 0
+    return (1 << (n - 1).bit_length()) - 1
+
+
+@dataclass
+class PGPool:
+    """osd_types.h → pg_pool_t (placement-relevant subset)."""
+
+    pool_id: int
+    pg_num: int
+    size: int = 3
+    min_size: int = 2
+    crush_rule: int = 0
+    pgp_num: Optional[int] = None       # defaults to pg_num
+    erasure: bool = False               # TYPE_ERASURE: holes preserved
+    hashpspool: bool = True             # FLAG_HASHPSPOOL (default on)
+
+    def __post_init__(self) -> None:
+        if self.pgp_num is None:
+            self.pgp_num = self.pg_num
+
+    @property
+    def pg_num_mask(self) -> int:
+        return pg_mask(self.pg_num)
+
+    @property
+    def pgp_num_mask(self) -> int:
+        return pg_mask(self.pgp_num)
+
+    def can_shift_osds(self) -> bool:
+        """osd_types.h → pg_pool_t::can_shift_osds: replicated pools
+        compact holes; erasure pools keep positional NONEs."""
+        return not self.erasure
+
+    def raw_pg_to_pg(self, ps: int) -> int:
+        """osd_types.cc → pg_pool_t::raw_pg_to_pg (seed fold)."""
+        return ceph_stable_mod(ps, self.pg_num, self.pg_num_mask)
+
+    def raw_pg_to_pps(self, ps: int) -> int:
+        """osd_types.cc → pg_pool_t::raw_pg_to_pps: the CRUSH input.
+
+        HASHPSPOOL (default): hash the folded seed WITH the pool so
+        pools with the same rule land on different osd sequences."""
+        if self.hashpspool:
+            return int(crush_hash32_2(
+                ceph_stable_mod(ps, self.pgp_num, self.pgp_num_mask),
+                self.pool_id & 0xFFFFFFFF))
+        return ceph_stable_mod(ps, self.pgp_num,
+                               self.pgp_num_mask) + self.pool_id
+
+    def pps_all(self) -> np.ndarray:
+        """Vectorized raw_pg_to_pps for ps = 0..pg_num-1 (bulk path)."""
+        ps = np.arange(self.pg_num, dtype=np.int64)
+        folded = np.where((ps & self.pgp_num_mask) < self.pgp_num,
+                          ps & self.pgp_num_mask,
+                          ps & (self.pgp_num_mask >> 1))
+        if self.hashpspool:
+            # the hash works over uint32 arrays (wraparound semantics)
+            return crush_hash32_2(
+                folded.astype(np.uint32),
+                np.uint32(self.pool_id & 0xFFFFFFFF)).astype(np.int64)
+        return folded + self.pool_id
+
+
+@dataclass
+class OSDMap:
+    """src/osd/OSDMap.h → OSDMap (placement-relevant subset)."""
+
+    crush: CrushMap
+    pools: Dict[int, PGPool] = field(default_factory=dict)
+    max_osd: int = 0
+    # per-osd state vectors (OSDMap: osd_state / osd_weight /
+    # osd_primary_affinity)
+    osd_exists: List[bool] = field(default_factory=list)
+    osd_up: List[bool] = field(default_factory=list)
+    osd_weight: List[int] = field(default_factory=list)       # 16.16 out
+    osd_primary_affinity: Optional[List[int]] = None          # 16.16
+    # override layers, keyed by (pool_id, folded pg seed)
+    pg_upmap: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
+    pg_upmap_items: Dict[Tuple[int, int], List[Tuple[int, int]]] = \
+        field(default_factory=dict)
+    pg_temp: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
+    primary_temp: Dict[Tuple[int, int], int] = field(default_factory=dict)
+    choose_args_name: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if not self.max_osd:
+            self.max_osd = self.crush.max_devices
+        for vec, fill in ((self.osd_exists, True), (self.osd_up, True),
+                          (self.osd_weight, IN_WEIGHT)):
+            while len(vec) < self.max_osd:
+                vec.append(fill)
+
+    # -- state helpers (OSDMap::exists / is_up / is_down) ----------------
+
+    def exists(self, osd: int) -> bool:
+        return 0 <= osd < self.max_osd and self.osd_exists[osd]
+
+    def is_up(self, osd: int) -> bool:
+        return self.exists(osd) and self.osd_up[osd]
+
+    def is_out(self, osd: int) -> bool:
+        return not self.exists(osd) or self.osd_weight[osd] == 0
+
+    def mark_down(self, osd: int) -> None:
+        self.osd_up[osd] = False
+
+    def mark_out(self, osd: int) -> None:
+        self.osd_weight[osd] = 0
+
+    def set_primary_affinity(self, osd: int, aff: int) -> None:
+        if self.osd_primary_affinity is None:
+            self.osd_primary_affinity = [MAX_PRIMARY_AFFINITY] * self.max_osd
+        self.osd_primary_affinity[osd] = aff
+
+    def _choose_args(self):
+        if self.choose_args_name is None:
+            return None
+        return self.crush.choose_args[self.choose_args_name]
+
+    def _compiled_map(self):
+        """Lazily-built CompiledCrushMap reused across bulk calls (the
+        jit cache lives on it; rebuilding per call would re-trace).
+        Call invalidate_compiled() after editing the crush hierarchy
+        or switching choose_args_name."""
+        cm = self.__dict__.get("_compiled")
+        if cm is None or cm.cmap is not self.crush \
+                or cm.choose_args is not self._choose_args():
+            from .bulk import CompiledCrushMap
+            cm = CompiledCrushMap(self.crush, self._choose_args())
+            self.__dict__["_compiled"] = cm
+        return cm
+
+    def invalidate_compiled(self) -> None:
+        self.__dict__.pop("_compiled", None)
+
+    # -- stage 1: raw CRUSH placement (OSDMap::_pg_to_raw_osds) ----------
+
+    def pg_to_raw_osds(self, pool_id: int, ps: int) -> Tuple[List[int], int]:
+        """(raw osd vector, pps seed)."""
+        pool = self.pools[pool_id]
+        pps = pool.raw_pg_to_pps(ps)
+        raw = crush_do_rule(self.crush, pool.crush_rule, pps, pool.size,
+                            weight=list(self.osd_weight),
+                            choose_args=self._choose_args())
+        return raw, pps
+
+    # -- stage 2: upmap overrides (OSDMap::_apply_upmap) -----------------
+
+    def _apply_upmap(self, pool: PGPool, pg_seed: int,
+                     raw: List[int]) -> List[int]:
+        key = (pool.pool_id, pg_seed)
+        full = self.pg_upmap.get(key)
+        if full:
+            # reject wholesale iff a target is marked out (OSDMap.cc
+            # checks only in-range osds with weight 0)
+            for osd in full:
+                if (osd != CRUSH_ITEM_NONE and 0 <= osd < self.max_osd
+                        and self.osd_weight[osd] == 0):
+                    return raw
+            return list(full)
+        items = self.pg_upmap_items.get(key)
+        if items:
+            raw = list(raw)
+            for osd_from, osd_to in items:
+                for i, osd in enumerate(raw):
+                    if osd == osd_from:
+                        if (osd_to != CRUSH_ITEM_NONE
+                                and 0 <= osd_to < self.max_osd
+                                and self.osd_weight[osd_to] == 0):
+                            break   # target marked out: ignore this pair
+                        raw[i] = osd_to
+                        break       # first occurrence only
+        return raw
+
+    # -- stage 3: up-set from raw (OSDMap::_raw_to_up_osds) --------------
+
+    def _raw_to_up_osds(self, pool: PGPool, raw: List[int]) -> List[int]:
+        if pool.can_shift_osds():
+            return [o for o in raw
+                    if o != CRUSH_ITEM_NONE and self.is_up(o)]
+        return [o if o != CRUSH_ITEM_NONE and self.is_up(o)
+                else CRUSH_ITEM_NONE for o in raw]
+
+    # -- stage 4: primary affinity (OSDMap::_apply_primary_affinity) -----
+
+    def _pick_primary(self, osds: Sequence[int]) -> int:
+        for o in osds:
+            if o != CRUSH_ITEM_NONE:
+                return o
+        return -1
+
+    def _apply_primary_affinity(self, pps: int, pool: PGPool,
+                                osds: List[int]) -> Tuple[List[int], int]:
+        aff = self.osd_primary_affinity
+        primary = self._pick_primary(osds)
+        if aff is None or primary < 0:
+            return osds, primary
+        if all(aff[o] == MAX_PRIMARY_AFFINITY
+               for o in osds if o != CRUSH_ITEM_NONE):
+            return osds, primary
+        pos = -1
+        for i, o in enumerate(osds):
+            if o == CRUSH_ITEM_NONE:
+                continue
+            a = aff[o]
+            if a < MAX_PRIMARY_AFFINITY and \
+                    (int(crush_hash32_2(pps, o)) >> 16) >= a:
+                # hash draw says skip; remember the first as fallback
+                if pos < 0:
+                    pos = i
+            else:
+                pos = i
+                break
+        if pos < 0:
+            return osds, primary
+        primary = osds[pos]
+        if pool.can_shift_osds() and pos > 0:
+            # move the chosen primary to the front, preserving order
+            osds = [osds[pos]] + osds[:pos] + osds[pos + 1:]
+        return osds, primary
+
+    # -- stage 5: temp overrides (OSDMap::_get_temp_osds) ----------------
+
+    def _get_temp_osds(self, pool: PGPool, pg_seed: int
+                       ) -> Tuple[Optional[List[int]], int]:
+        key = (pool.pool_id, pg_seed)
+        temp = self.pg_temp.get(key)
+        temp_pg = None
+        if temp:
+            if pool.can_shift_osds():
+                temp_pg = [o for o in temp if self.exists(o)] or None
+            else:
+                # positional EC pools: a dne osd leaves a NONE hole in
+                # its shard slot (OSDMap.cc: "NONE takes over for a dne
+                # osd"), never shifting later shards
+                temp_pg = [o if o == CRUSH_ITEM_NONE or self.exists(o)
+                           else CRUSH_ITEM_NONE for o in temp]
+        temp_primary = self.primary_temp.get(key, -1)
+        if temp_primary < 0 and temp_pg:
+            temp_primary = self._pick_primary(temp_pg)
+        return temp_pg, temp_primary
+
+    # -- the public pipeline (OSDMap::pg_to_up_acting_osds) --------------
+
+    def pg_to_up_acting_osds(self, pool_id: int, ps: int
+                             ) -> Tuple[List[int], int, List[int], int]:
+        """(up, up_primary, acting, acting_primary) for pg = pool.ps."""
+        pool = self.pools[pool_id]
+        pg_seed = pool.raw_pg_to_pg(ps)
+        raw, pps = self.pg_to_raw_osds(pool_id, ps)
+        raw = self._apply_upmap(pool, pg_seed, raw)
+        up = self._raw_to_up_osds(pool, raw)
+        up, up_primary = self._apply_primary_affinity(pps, pool, up)
+        temp_pg, temp_primary = self._get_temp_osds(pool, pg_seed)
+        acting = list(temp_pg) if temp_pg is not None else list(up)
+        acting_primary = temp_primary if temp_primary >= 0 else up_primary
+        return up, up_primary, acting, acting_primary
+
+    # -- bulk path: every pg of a pool in one device call ----------------
+
+    def pg_to_up_bulk(self, pool_id: int, engine: str = "bulk"
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """(up (pg_num, size) int32 with NONE holes kept positional,
+        up_primary (pg_num,)) for every pg of the pool.
+
+        Raw placements run through the fused device evaluator
+        (crush/bulk.py, engine="bulk") or the host mapper
+        (engine="host"); the sparse upmap/affinity layers are then
+        applied host-side, mirroring the scalar pipeline exactly.
+        pg_temp/primary_temp (the acting overrides) are NOT applied
+        here — see pg_to_up_acting_bulk."""
+        pool = self.pools[pool_id]
+        pps = pool.pps_all()
+        if engine == "bulk":
+            from .bulk import bulk_do_rule
+            out, cnt = bulk_do_rule(
+                self._compiled_map(), pool.crush_rule, pps, pool.size,
+                weight=list(self.osd_weight))
+            raws = [list(out[i, :cnt[i]]) for i in range(pool.pg_num)]
+        else:
+            raws = [crush_do_rule(self.crush, pool.crush_rule, int(x),
+                                  pool.size, weight=list(self.osd_weight),
+                                  choose_args=self._choose_args())
+                    for x in pps]
+        up = np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE, np.int32)
+        up_primary = np.full(pool.pg_num, -1, np.int32)
+        for ps in range(pool.pg_num):
+            pg_seed = pool.raw_pg_to_pg(ps)
+            raw = self._apply_upmap(pool, pg_seed, [int(o) for o in raws[ps]])
+            u = self._raw_to_up_osds(pool, raw)
+            u, prim = self._apply_primary_affinity(int(pps[ps]), pool, u)
+            up[ps, :len(u)] = u
+            up_primary[ps] = prim
+        return up, up_primary
+
+    def pg_to_up_acting_bulk(self, pool_id: int, engine: str = "bulk"
+                             ) -> Tuple[np.ndarray, np.ndarray,
+                                        np.ndarray, np.ndarray]:
+        """Bulk pg_to_up_acting_osds over the whole pool: (up,
+        up_primary, acting, acting_primary) arrays.  The acting array
+        is wide enough for the longest pg_temp override (the scalar
+        path returns oversized temp lists verbatim; nothing is
+        truncated), padded with NONE."""
+        pool = self.pools[pool_id]
+        up, up_primary = self.pg_to_up_bulk(pool_id, engine=engine)
+        temps = {}
+        for ps in range(pool.pg_num):
+            temp_pg, temp_primary = self._get_temp_osds(
+                pool, pool.raw_pg_to_pg(ps))
+            if temp_pg is not None or temp_primary >= 0:
+                temps[ps] = (temp_pg, temp_primary)
+        width = max([pool.size] + [len(t[0]) for t in temps.values()
+                                   if t[0] is not None])
+        acting = np.full((pool.pg_num, width), CRUSH_ITEM_NONE, np.int32)
+        acting[:, :pool.size] = up
+        acting_primary = up_primary.copy()
+        for ps, (temp_pg, temp_primary) in temps.items():
+            if temp_pg is not None:
+                acting[ps] = list(temp_pg) + \
+                    [CRUSH_ITEM_NONE] * (width - len(temp_pg))
+            if temp_primary >= 0:
+                acting_primary[ps] = temp_primary
+            elif temp_pg is not None:
+                acting_primary[ps] = self._pick_primary(temp_pg)
+        return up, up_primary, acting, acting_primary
+
+    # -- distribution scoring (balancer building block) ------------------
+
+    def pg_counts_per_osd(self, pool_id: int, engine: str = "bulk"
+                          ) -> np.ndarray:
+        """Number of pg replicas mapped to each osd (the balancer's
+        objective input)."""
+        up, _ = self.pg_to_up_bulk(pool_id, engine=engine)
+        flat = up.ravel()
+        flat = flat[(flat != CRUSH_ITEM_NONE) & (flat >= 0)]
+        return np.bincount(flat, minlength=self.max_osd)

@@ -1,0 +1,251 @@
+"""OSDMap pg→OSD pipeline (crush/osdmap.py) — pps seeds, upmap layers,
+up-set derivation, primary affinity, temp overrides, and the bulk path
+pinned against the scalar pipeline.
+
+Reference semantics: src/osd/OSDMap.cc → pg_to_up_acting_osds and
+helpers; src/osd/osd_types.cc → pg_pool_t."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (
+    CrushBuilder,
+    step_chooseleaf_firstn,
+    step_chooseleaf_indep,
+    step_emit,
+    step_take,
+)
+from ceph_tpu.crush.osdmap import (
+    IN_WEIGHT,
+    MAX_PRIMARY_AFFINITY,
+    OSDMap,
+    PGPool,
+    ceph_stable_mod,
+    pg_mask,
+)
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+
+
+def make_map(n_hosts=4, devs=2, size=3, erasure=False, pg_num=64,
+             rule_indep=False):
+    b = CrushBuilder()
+    root = b.build_two_level(n_hosts, devs)
+    step = step_chooseleaf_indep if rule_indep else step_chooseleaf_firstn
+    b.add_rule(0, [step_take(root), step(size, b.type_id("host")),
+                   step_emit()])
+    m = OSDMap(crush=b.map)
+    m.pools[1] = PGPool(pool_id=1, pg_num=pg_num, size=size,
+                        erasure=erasure)
+    return m
+
+
+# -- pg_pool_t math ------------------------------------------------------
+
+def test_stable_mod_matches_reference_definition():
+    # include/rados.h: if ((x & bmask) < b) x & bmask else x & (bmask>>1)
+    assert ceph_stable_mod(13, 12, 15) == 5     # 13 >= 12 -> 13 & 7
+    assert ceph_stable_mod(11, 12, 15) == 11    # below b: x & bmask
+    assert ceph_stable_mod(21, 12, 15) == 5     # 21&15=5 < 12
+    # power of two: plain mask
+    for x in range(40):
+        assert ceph_stable_mod(x, 16, 15) == x % 16
+
+
+def test_pg_mask_calc():
+    # osd_types.cc calc_pg_masks: (1 << cbits(n-1)) - 1
+    assert pg_mask(1) == 0
+    assert pg_mask(12) == 15
+    assert pg_mask(16) == 15
+    assert pg_mask(17) == 31
+    assert pg_mask(1024) == 1023
+
+
+def test_stable_mod_distribution_covers_range():
+    # every seed in [0, pg_num) is hit by folding [0, mask]
+    pool = PGPool(pool_id=0, pg_num=12)
+    seeds = {pool.raw_pg_to_pg(x) for x in range(64)}
+    assert seeds == set(range(12))
+
+
+def test_pps_hashpspool_vs_legacy():
+    p_hash = PGPool(pool_id=3, pg_num=16)
+    p_legacy = PGPool(pool_id=3, pg_num=16, hashpspool=False)
+    # legacy: seed + pool id (linear)
+    assert p_legacy.raw_pg_to_pps(5) == 5 + 3
+    # hashpspool: rjenkins mix, must differ per pool for same seed
+    other = PGPool(pool_id=4, pg_num=16)
+    assert p_hash.raw_pg_to_pps(5) != other.raw_pg_to_pps(5)
+
+
+def test_pps_all_matches_scalar():
+    for pool in (PGPool(pool_id=2, pg_num=48),
+                 PGPool(pool_id=2, pg_num=48, hashpspool=False),
+                 PGPool(pool_id=7, pg_num=33, pgp_num=17)):
+        vec = pool.pps_all()
+        ref = [pool.raw_pg_to_pps(ps) for ps in range(pool.pg_num)]
+        assert vec.tolist() == ref
+
+
+# -- pipeline stages -----------------------------------------------------
+
+def test_pg_to_up_basic_replicated():
+    m = make_map()
+    up, upp, acting, actp = m.pg_to_up_acting_osds(1, 5)
+    assert len(up) == 3 and len(set(up)) == 3
+    assert all(0 <= o < m.max_osd for o in up)
+    assert upp == up[0] and acting == up and actp == upp
+    # deterministic
+    assert m.pg_to_up_acting_osds(1, 5)[0] == up
+
+
+def test_failure_domain_separation():
+    m = make_map(n_hosts=6, devs=2)
+    for ps in range(32):
+        up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+        hosts = {o // 2 for o in up}
+        assert len(hosts) == len(up)
+
+
+def test_raw_to_up_shifts_replicated_but_holes_erasure():
+    m_rep = make_map()
+    m_ec = make_map(erasure=True, rule_indep=True)
+    ps = next(ps for ps in range(64)
+              if m_rep.pg_to_up_acting_osds(1, ps)[0][1] == 3)
+    m_rep.mark_down(3)
+    up, _, _, _ = m_rep.pg_to_up_acting_osds(1, ps)
+    assert 3 not in up and len(up) == 2          # shifted left
+
+    ps = next(ps for ps in range(64)
+              if m_ec.pg_to_up_acting_osds(1, ps)[0][1] == 3)
+    m_ec.mark_down(3)
+    up, _, _, _ = m_ec.pg_to_up_acting_osds(1, ps)
+    assert up[1] == CRUSH_ITEM_NONE and len(up) == 3  # positional hole
+
+
+def test_pg_upmap_full_override_and_out_rejection():
+    m = make_map()
+    pool = m.pools[1]
+    ps = 9
+    seed = pool.raw_pg_to_pg(ps)
+    m.pg_upmap[(1, seed)] = [0, 2, 4]
+    up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+    assert up == [0, 2, 4]
+    # a target marked out rejects the whole explicit mapping: the pg
+    # falls back to its raw CRUSH placement (same map, no upmap entry)
+    m.mark_out(2)
+    up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+    del m.pg_upmap[(1, seed)]
+    expected, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+    assert up == expected and 2 not in up
+
+
+def test_pg_upmap_items_swap_first_occurrence():
+    m = make_map()
+    pool = m.pools[1]
+    ps = 3
+    up0, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+    victim = up0[1]
+    # pick a replacement not already in the set
+    repl = next(o for o in range(m.max_osd) if o not in up0)
+    m.pg_upmap_items[(1, pool.raw_pg_to_pg(ps))] = [(victim, repl)]
+    up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+    assert up[1] == repl and up[0] == up0[0] and up[2] == up0[2]
+    # out target: pair ignored
+    m.mark_out(repl)
+    up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+    assert up == up0
+
+
+def test_primary_affinity_demotes_and_front_shifts():
+    m = make_map()
+    up0, upp0, _, _ = m.pg_to_up_acting_osds(1, 7)
+    m.set_primary_affinity(upp0, 0)   # never primary
+    up, upp, _, _ = m.pg_to_up_acting_osds(1, 7)
+    assert upp != upp0 and upp in up0
+    # replicated pools rotate the chosen primary to the front
+    assert up[0] == upp and sorted(up) == sorted(up0)
+
+
+def test_primary_affinity_erasure_keeps_positions():
+    m = make_map(erasure=True, rule_indep=True)
+    up0, upp0, _, _ = m.pg_to_up_acting_osds(1, 7)
+    m.set_primary_affinity(upp0, 0)
+    up, upp, _, _ = m.pg_to_up_acting_osds(1, 7)
+    assert up == up0                   # no shifting for EC pools
+    assert upp != upp0 and upp in up0
+
+
+def test_pg_temp_and_primary_temp_override_acting():
+    m = make_map()
+    pool = m.pools[1]
+    ps = 11
+    up, upp, _, _ = m.pg_to_up_acting_osds(1, ps)
+    seed = pool.raw_pg_to_pg(ps)
+    m.pg_temp[(1, seed)] = [7, 6, 5]
+    up2, upp2, acting, actp = m.pg_to_up_acting_osds(1, ps)
+    assert up2 == up and upp2 == upp            # up unaffected
+    assert acting == [7, 6, 5] and actp == 7
+    m.primary_temp[(1, seed)] = 6
+    _, _, _, actp = m.pg_to_up_acting_osds(1, ps)
+    assert actp == 6
+
+
+def test_pg_temp_nonexistent_osd_semantics():
+    # replicated: dne osds are dropped (shift); EC: NONE hole in place
+    m_rep = make_map()
+    m_ec = make_map(erasure=True, rule_indep=True)
+    seed_rep = m_rep.pools[1].raw_pg_to_pg(4)
+    m_rep.pg_temp[(1, seed_rep)] = [1, 99, 3]       # 99 doesn't exist
+    _, _, acting, actp = m_rep.pg_to_up_acting_osds(1, 4)
+    assert acting == [1, 3] and actp == 1
+    seed_ec = m_ec.pools[1].raw_pg_to_pg(4)
+    m_ec.pg_temp[(1, seed_ec)] = [1, 99, 3]
+    _, _, acting, actp = m_ec.pg_to_up_acting_osds(1, 4)
+    assert acting == [1, CRUSH_ITEM_NONE, 3] and actp == 1
+
+
+def test_bulk_acting_keeps_oversized_pg_temp():
+    m = make_map(pg_num=16)
+    pool = m.pools[1]
+    m.pg_temp[(1, pool.raw_pg_to_pg(3))] = [0, 1, 2, 3]  # longer than size
+    up, upp, acting, actp = m.pg_to_up_acting_bulk(1, engine="host")
+    assert acting.shape[1] == 4
+    assert acting[3].tolist() == [0, 1, 2, 3]
+    scalar = m.pg_to_up_acting_osds(1, 3)
+    assert scalar[2] == [0, 1, 2, 3] and actp[3] == scalar[3]
+
+
+# -- bulk path -----------------------------------------------------------
+
+@pytest.mark.parametrize("engine", ["host", "bulk"])
+@pytest.mark.parametrize("erasure", [False, True])
+def test_bulk_matches_scalar_pipeline(engine, erasure):
+    m = make_map(n_hosts=5, devs=3, erasure=erasure, pg_num=48,
+                 rule_indep=erasure)
+    pool = m.pools[1]
+    # make it interesting: a down osd, an upmap item, affinity, pg_temp
+    m.mark_down(4)
+    m.set_primary_affinity(0, MAX_PRIMARY_AFFINITY // 7)
+    up0, *_ = m.pg_to_up_acting_osds(1, 2)
+    present = [o for o in up0 if o != CRUSH_ITEM_NONE]
+    free = next(o for o in range(m.max_osd)
+                if o not in present and m.is_up(o))
+    m.pg_upmap_items[(1, pool.raw_pg_to_pg(2))] = [(present[0], free)]
+    m.pg_temp[(1, pool.raw_pg_to_pg(5))] = [1, 2, 3]
+
+    up, upp, acting, actp = m.pg_to_up_acting_bulk(1, engine=engine)
+    for ps in range(pool.pg_num):
+        u, p, a, ap = m.pg_to_up_acting_osds(1, ps)
+        padded = (u + [CRUSH_ITEM_NONE] * pool.size)[:pool.size]
+        assert up[ps].tolist() == padded, f"ps={ps}"
+        assert upp[ps] == p, f"ps={ps}"
+        a_padded = (a + [CRUSH_ITEM_NONE] * pool.size)[:pool.size]
+        assert acting[ps].tolist() == a_padded, f"ps={ps}"
+        assert actp[ps] == ap, f"ps={ps}"
+
+
+def test_pg_counts_per_osd_sums():
+    m = make_map(n_hosts=4, devs=2, pg_num=128)
+    counts = m.pg_counts_per_osd(1, engine="host")
+    assert counts.sum() == 128 * 3
+    assert (counts > 0).all()          # every osd gets work at this scale
