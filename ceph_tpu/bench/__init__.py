@@ -1,3 +1,14 @@
-"""Benchmark harness (mirrors src/test/erasure-code/ceph_erasure_code_benchmark.{h,cc})."""
+"""Benchmark + tool CLIs (SURVEY.md L6):
+
+- ``erasure_code_benchmark`` — ceph_erasure_code_benchmark analog
+  (src/test/erasure-code/ceph_erasure_code_benchmark.{h,cc}).
+- ``erasure_code_tool`` — ceph_erasure_code analog (plugin/profile
+  validity probe, src/test/erasure-code/ceph_erasure_code.cc).
+- ``crushtool`` — crushtool analog (src/tools/crushtool.cc).
+- ``osdmaptool`` — osdmaptool analog (src/tools/osdmaptool.cc):
+  --test-map-pgs sweeps, --upmap balancer runs, --createsimple.
+- ``non_regression`` — byte-stability corpus writer/checker
+  (ceph_erasure_code_non_regression.cc).
+"""
 
 from .erasure_code_benchmark import ErasureCodeBench, main  # noqa: F401

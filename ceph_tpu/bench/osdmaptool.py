@@ -1,0 +1,185 @@
+"""osdmaptool-equivalent CLI — src/tools/osdmaptool.cc.
+
+Supported surface (the modes that exercise placement math; epoch/
+incremental surgery needs a mon and is out of scope, SURVEY.md §7):
+
+  python -m ceph_tpu.bench.osdmaptool MAP --test-map-pgs [--pool ID]
+      per-pool pg→OSD sweep through the full OSDMap pipeline (pps,
+      upmap, affinity, temp) on the bulk evaluator; prints the
+      per-osd count histogram + avg/min/max like the reference.
+  python -m ceph_tpu.bench.osdmaptool MAP --upmap OUT [--pool ID]
+      [--upmap-deviation D] [--upmap-max N]
+      balancer run (OSDMap::calc_pg_upmaps); writes `ceph osd
+      pg-upmap-items ...` command lines to OUT, the reference's
+      output format for feeding back to a cluster.
+  python -m ceph_tpu.bench.osdmaptool --createsimple N -o MAP
+      build a fresh map with N osds (one host each), a replicated
+      pool, and jewel tunables (osdmaptool --createsimple analog).
+
+MAP is a JSON document:
+  {"crush": <crush map in this framework's JSON interchange form, or
+             a path to a text/binary/JSON crushmap file>,
+   "pools": [{"pool_id": 1, "pg_num": 256, "size": 3,
+              "crush_rule": 0, "erasure": false}, ...],
+   "osd_weight": {"3": 0.5}, "osd_down": [7], "osd_out": [7],
+   "primary_affinity": {"2": 0.5},
+   "pg_upmap_items": {"1.5": [[3, 9]]}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict
+
+import numpy as np
+
+from ..crush.balancer import calc_pg_upmaps
+from ..crush.osdmap import IN_WEIGHT, MAX_PRIMARY_AFFINITY, OSDMap, PGPool
+from ..crush.types import CRUSH_ITEM_NONE
+from .crushtool import read_map
+
+
+def load_osdmap(path: str) -> OSDMap:
+    spec = json.load(open(path))
+    crush_spec = spec["crush"]
+    if isinstance(crush_spec, str):
+        cmap = read_map(crush_spec)
+    else:
+        from ..crush.compiler import compile_map
+        cmap = compile_map(json.dumps(crush_spec))
+    m = OSDMap(crush=cmap)
+    for p in spec.get("pools", []):
+        pool = PGPool(**{k: v for k, v in p.items()})
+        m.pools[pool.pool_id] = pool
+    for osd, w in spec.get("osd_weight", {}).items():
+        m.osd_weight[int(osd)] = int(float(w) * IN_WEIGHT)
+    for osd in spec.get("osd_down", []):
+        m.mark_down(int(osd))
+    for osd in spec.get("osd_out", []):
+        m.osd_weight[int(osd)] = 0
+    for osd, a in spec.get("primary_affinity", {}).items():
+        m.set_primary_affinity(int(osd), int(float(a) * MAX_PRIMARY_AFFINITY))
+    for pgid, items in spec.get("pg_upmap_items", {}).items():
+        pool_id, seed = pgid.split(".")
+        m.pg_upmap_items[(int(pool_id), int(seed))] = [
+            (int(f), int(t)) for f, t in items]
+    return m
+
+
+def dump_osdmap(m: OSDMap, pools) -> Dict:
+    from ..crush.compiler import decompile
+    return {
+        "crush": json.loads(decompile(m.crush)),
+        "pools": [{"pool_id": p.pool_id, "pg_num": p.pg_num,
+                   "size": p.size, "crush_rule": p.crush_rule,
+                   "erasure": p.erasure} for p in pools],
+    }
+
+
+def test_map_pgs(m: OSDMap, pool_ids, engine: str) -> int:
+    total = np.zeros(m.max_osd, dtype=np.int64)
+    n_pgs = 0
+    begin = time.perf_counter()
+    for pid in pool_ids:
+        pool = m.pools[pid]
+        up, _, acting, _ = m.pg_to_up_acting_bulk(pid, engine=engine)
+        n_pgs += pool.pg_num
+        flat = acting.ravel()
+        flat = flat[(flat != CRUSH_ITEM_NONE) & (flat >= 0)]
+        total += np.bincount(flat, minlength=m.max_osd)
+    elapsed = time.perf_counter() - begin
+    # osdmaptool --test-map-pgs output shape: per-osd counts + summary
+    for osd in range(m.max_osd):
+        print(f"osd.{osd}\t{total[osd]}")
+    in_osds = total[total > 0]
+    avg = in_osds.mean() if in_osds.size else 0.0
+    print(f"#osd\tcount\tfirst\tprimary\tc wt\twt")
+    print(f" avg {avg:.2f} stddev {in_osds.std() if in_osds.size else 0:.2f}"
+          f" min {in_osds.min() if in_osds.size else 0}"
+          f" max {in_osds.max() if in_osds.size else 0}")
+    print(f"mapped {n_pgs} pgs in {elapsed:.3f}s "
+          f"({n_pgs / elapsed:.0f} pgs/s, engine={engine})")
+    return 0
+
+
+def upmap(m: OSDMap, pool_ids, out_path: str, deviation: float,
+          max_entries: int, engine: str) -> int:
+    lines = []
+    for pid in pool_ids:
+        changes = calc_pg_upmaps(m, pid, max_deviation=deviation,
+                                 max_iterations=max_entries,
+                                 engine=engine)
+        for (pool_id, seed), items in sorted(changes.items()):
+            flat = " ".join(f"{f} {t}" for f, t in items)
+            lines.append(
+                f"ceph osd pg-upmap-items {pool_id}.{seed} {flat}")
+    out = open(out_path, "w") if out_path != "-" else sys.stdout
+    for ln in lines:
+        print(ln, file=out)
+    if out is not sys.stdout:
+        out.close()
+        print(f"wrote {len(lines)} pg-upmap-items commands to {out_path}")
+    return 0
+
+
+def createsimple(n: int, out_path: str, pg_num: int) -> int:
+    from ..crush.builder import CrushBuilder
+    from ..crush.types import (step_chooseleaf_firstn, step_emit,
+                               step_take)
+    b = CrushBuilder()
+    root = b.build_two_level(n, 1)
+    b.add_rule(0, [step_take(root),
+                   step_chooseleaf_firstn(0, b.type_id("host")),
+                   step_emit()], name="replicated_rule")
+    m = OSDMap(crush=b.map)
+    pool = PGPool(pool_id=1, pg_num=pg_num, size=3)
+    m.pools[1] = pool
+    json.dump(dump_osdmap(m, [pool]), open(out_path, "w"), indent=1)
+    print(f"osdmaptool: wrote {n}-osd map with pool 1 "
+          f"(pg_num={pg_num}) to {out_path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="osdmaptool",
+                                description=__doc__.split("\n")[0])
+    p.add_argument("mapfn", nargs="?", help="OSDMap JSON file")
+    p.add_argument("--test-map-pgs", action="store_true")
+    p.add_argument("--upmap", metavar="OUT",
+                   help="write pg-upmap-items commands ('-' = stdout)")
+    p.add_argument("--upmap-deviation", type=float, default=1.0)
+    p.add_argument("--upmap-max", type=int, default=100)
+    p.add_argument("--pool", type=int, action="append",
+                   help="restrict to pool id (repeatable)")
+    p.add_argument("--engine", choices=("host", "bulk"), default="bulk")
+    p.add_argument("--createsimple", type=int, metavar="N")
+    p.add_argument("--pg-num", type=int, default=128,
+                   help="pg_num for --createsimple pools")
+    p.add_argument("-o", "--outfn", help="output map for --createsimple")
+    a = p.parse_args(argv)
+
+    if a.createsimple:
+        if not a.outfn:
+            p.error("--createsimple requires -o")
+        return createsimple(a.createsimple, a.outfn, a.pg_num)
+    if not a.mapfn:
+        p.error("an OSDMap JSON file is required")
+    m = load_osdmap(a.mapfn)
+    pool_ids = a.pool or sorted(m.pools)
+    for pid in pool_ids:
+        if pid not in m.pools:
+            p.error(f"pool {pid} not in map")
+    if a.test_map_pgs:
+        return test_map_pgs(m, pool_ids, a.engine)
+    if a.upmap:
+        return upmap(m, pool_ids, a.upmap, a.upmap_deviation,
+                     a.upmap_max, a.engine)
+    p.error("nothing to do (--test-map-pgs / --upmap / --createsimple)")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,6 +212,8 @@ class OSDMap:
         if items:
             raw = list(raw)
             for osd_from, osd_to in items:
+                if osd_to in raw:
+                    continue        # target already holds a replica
                 for i, osd in enumerate(raw):
                     if osd == osd_from:
                         if (osd_to != CRUSH_ITEM_NONE
@@ -332,15 +334,21 @@ class OSDMap:
                                   pool.size, weight=list(self.osd_weight),
                                   choose_args=self._choose_args())
                     for x in pps]
-        up = np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE, np.int32)
+        ups = []
         up_primary = np.full(pool.pg_num, -1, np.int32)
         for ps in range(pool.pg_num):
             pg_seed = pool.raw_pg_to_pg(ps)
             raw = self._apply_upmap(pool, pg_seed, [int(o) for o in raws[ps]])
             u = self._raw_to_up_osds(pool, raw)
             u, prim = self._apply_primary_affinity(int(pps[ps]), pool, u)
-            up[ps, :len(u)] = u
+            ups.append(u)
             up_primary[ps] = prim
+        # a full pg_upmap vector may exceed pool.size (the scalar path
+        # returns it verbatim); widen instead of truncating
+        width = max([pool.size] + [len(u) for u in ups])
+        up = np.full((pool.pg_num, width), CRUSH_ITEM_NONE, np.int32)
+        for ps, u in enumerate(ups):
+            up[ps, :len(u)] = u
         return up, up_primary
 
     def pg_to_up_acting_bulk(self, pool_id: int, engine: str = "bulk"
@@ -359,10 +367,10 @@ class OSDMap:
                 pool, pool.raw_pg_to_pg(ps))
             if temp_pg is not None or temp_primary >= 0:
                 temps[ps] = (temp_pg, temp_primary)
-        width = max([pool.size] + [len(t[0]) for t in temps.values()
-                                   if t[0] is not None])
+        width = max([up.shape[1]] + [len(t[0]) for t in temps.values()
+                                     if t[0] is not None])
         acting = np.full((pool.pg_num, width), CRUSH_ITEM_NONE, np.int32)
-        acting[:, :pool.size] = up
+        acting[:, :up.shape[1]] = up
         acting_primary = up_primary.copy()
         for ps, (temp_pg, temp_primary) in temps.items():
             if temp_pg is not None:

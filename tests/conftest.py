@@ -14,6 +14,11 @@ which holds at conftest import time.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests (and every python SUBPROCESS they spawn — CLI tests, the native
+# bridge) must never dial the axon relay: sitecustomize registers the
+# PJRT plugin whenever PALLAS_AXON_POOL_IPS is set, and a wedged tunnel
+# blocks that call indefinitely regardless of JAX_PLATFORMS.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -156,6 +156,31 @@ def test_pg_upmap_items_swap_first_occurrence():
     assert up == up0
 
 
+def test_pg_upmap_items_target_already_in_set_skipped():
+    # OSDMap.cc skips a pair whose target already holds a replica —
+    # otherwise the up set would contain a duplicate osd
+    m = make_map()
+    ps = 6
+    up0, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+    m.pg_upmap_items[(1, m.pools[1].raw_pg_to_pg(ps))] = [
+        (up0[0], up0[1])]               # target is already member
+    up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+    assert up == up0
+    assert len(set(up)) == len(up)
+
+
+def test_bulk_handles_oversized_pg_upmap():
+    m = make_map(pg_num=16)
+    pool = m.pools[1]
+    seed = pool.raw_pg_to_pg(2)
+    m.pg_upmap[(1, seed)] = [0, 2, 4, 6]    # wider than pool.size
+    up, upp = m.pg_to_up_bulk(1, engine="host")
+    assert up.shape[1] == 4
+    assert up[2].tolist() == [0, 2, 4, 6]
+    scalar, sp, _, _ = m.pg_to_up_acting_osds(1, 2)
+    assert scalar == [0, 2, 4, 6] and upp[2] == sp
+
+
 def test_primary_affinity_demotes_and_front_shifts():
     m = make_map()
     up0, upp0, _, _ = m.pg_to_up_acting_osds(1, 7)

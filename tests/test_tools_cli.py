@@ -1,0 +1,79 @@
+"""CLI surface tests for the osdmaptool and ceph_erasure_code analogs
+(ceph_tpu/bench/osdmaptool.py, erasure_code_tool.py)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def run(mod, *args):
+    return subprocess.run([sys.executable, "-m", mod, *args],
+                          capture_output=True, text=True, cwd=REPO_ROOT)
+
+
+def test_erasure_code_tool_plugin_exists():
+    r = run("ceph_tpu.bench.erasure_code_tool", "--plugin_exists",
+            "jerasure")
+    assert r.returncode == 0 and "exists" in r.stdout
+    r = run("ceph_tpu.bench.erasure_code_tool", "--plugin_exists",
+            "nonesuch")
+    assert r.returncode == 1
+
+
+def test_erasure_code_tool_profile_roundtrip():
+    r = run("ceph_tpu.bench.erasure_code_tool", "--plugin", "jerasure",
+            "--parameter", "k=4", "--parameter", "m=2",
+            "--parameter", "technique=reed_sol_van", "--all")
+    assert r.returncode == 0, r.stderr
+    assert "k=4 m=2" in r.stdout and "round-trip ok" in r.stdout
+
+
+def test_erasure_code_tool_bad_profile():
+    r = run("ceph_tpu.bench.erasure_code_tool", "--plugin", "jerasure",
+            "--parameter", "k=1", "--parameter", "m=2")
+    assert r.returncode == 1 and "failed to initialize" in r.stderr
+
+
+def test_osdmaptool_createsimple_testmappgs_upmap(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    r = run("ceph_tpu.bench.osdmaptool", "--createsimple", "6",
+            "--pg-num", "64", "-o", mapfn)
+    assert r.returncode == 0, r.stderr
+    spec = json.load(open(mapfn))
+    assert spec["pools"][0]["pg_num"] == 64
+
+    r = run("ceph_tpu.bench.osdmaptool", mapfn, "--test-map-pgs",
+            "--engine", "host")
+    assert r.returncode == 0, r.stderr
+    assert "mapped 64 pgs" in r.stdout and "osd.0" in r.stdout
+
+    outfn = str(tmp_path / "upmaps.sh")
+    r = run("ceph_tpu.bench.osdmaptool", mapfn, "--upmap", outfn,
+            "--upmap-deviation", "0.5", "--engine", "host")
+    assert r.returncode == 0, r.stderr
+    cmds = open(outfn).read().strip().splitlines()
+    assert all(c.startswith("ceph osd pg-upmap-items 1.") for c in cmds)
+
+
+def test_osdmaptool_overrides_affect_mapping(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "4",
+        "--pg-num", "32", "-o", mapfn)
+    spec = json.load(open(mapfn))
+    spec["osd_out"] = [0]
+    spec["osd_down"] = [0]
+    json.dump(spec, open(mapfn, "w"))
+    r = run("ceph_tpu.bench.osdmaptool", mapfn, "--test-map-pgs",
+            "--engine", "host")
+    assert r.returncode == 0, r.stderr
+    assert "osd.0\t0" in r.stdout       # out+down osd takes nothing
+
+
+def test_osdmaptool_requires_action(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "3", "-o", mapfn)
+    r = run("ceph_tpu.bench.osdmaptool", mapfn)
+    assert r.returncode == 2
