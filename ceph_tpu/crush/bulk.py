@@ -19,9 +19,10 @@ dense arrays and `crush_do_rule` becomes one fused jit program:
   exactly on the host reference mapper, so results are ALWAYS
   bit-identical to mapper.py / the C semantics, at any budget.
 
-Scope: straw2, legacy straw, and list buckets fuse (alg-dispatched per
-bucket row; pure-straw2 maps compile no extra branches); uniform
-(stateful bucket_perm_choose) and tree walks run on the host mapper.
+Scope: straw2, legacy straw, list, and tree buckets fuse
+(alg-dispatched per bucket row; pure-straw2 maps compile no extra
+branches); uniform (stateful bucket_perm_choose) runs on the host
+mapper.
 Jewel tunables (choose_local_* == 0).  Equivalence is pinned by
 tests/test_crush_bulk.py over randomized maps, rules and reweights.
 
@@ -47,6 +48,7 @@ from .ln import crush_ln
 from .mapper import crush_do_rule
 from .types import (
     CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_TREE,
     CRUSH_BUCKET_STRAW,
     CRUSH_BUCKET_STRAW2,
     CRUSH_ITEM_NONE,
@@ -87,10 +89,10 @@ class CompiledCrushMap:
                  ) -> None:
         for b in cmap.buckets.values():
             if b.alg not in (CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_STRAW,
-                             CRUSH_BUCKET_LIST):
+                             CRUSH_BUCKET_LIST, CRUSH_BUCKET_TREE):
                 raise ValueError(
-                    "bulk evaluator supports straw2/straw/list maps "
-                    "(uniform perm state and tree walks run on the host "
+                    "bulk evaluator supports straw2/straw/list/tree maps "
+                    "(uniform perm state runs on the host "
                     f"mapper); bucket alg {b.alg} is not fused")
         self.cmap = cmap
         self.choose_args = choose_args
@@ -114,6 +116,12 @@ class CompiledCrushMap:
         straws = np.zeros((self.n_buckets, S), np.int64)
         sum_weights = np.zeros((self.n_buckets, S), np.int64)
         raw_weights = np.zeros((self.n_buckets, S), np.int64)
+        NN = max((cmap.buckets[b].num_nodes for b in ids
+                  if cmap.buckets[b].alg == CRUSH_BUCKET_TREE),
+                 default=0)
+        node_weights = np.zeros((self.n_buckets, max(NN, 1)), np.int64)
+        tree_roots = np.ones(self.n_buckets, np.int32)
+        tree_steps = 0
         for bid, row in self.row_of_id.items():
             b = cmap.buckets[bid]
             items[row, :b.size] = b.items
@@ -126,6 +134,18 @@ class CompiledCrushMap:
             raw_weights[row, :b.size] = b.item_weights
             if b.alg == CRUSH_BUCKET_STRAW:
                 straws[row, :b.size] = b.straws
+            if b.alg == CRUSH_BUCKET_TREE:
+                if max(b.node_weights, default=0) >= 1 << 32:
+                    # crush.h node_weights are __u32; a wider weight is
+                    # unrepresentable in the wire format and would wrap
+                    # the device's u64 (hash * w) product
+                    raise ValueError(
+                        f"tree bucket {bid} node weight exceeds __u32; "
+                        "not fused — use engine=host")
+                node_weights[row, :b.num_nodes] = b.node_weights
+                tree_roots[row] = b.num_nodes >> 1
+                tree_steps = max(tree_steps,
+                                 max(b.num_nodes.bit_length() - 2, 0))
             if b.alg == CRUSH_BUCKET_LIST:
                 sum_weights[row, :b.size] = b.sum_weights
             arg = choose_args.get(bid) if choose_args else None
@@ -152,10 +172,15 @@ class CompiledCrushMap:
         # the map (pure-straw2 maps allocate none of them)
         has_straw = CRUSH_BUCKET_STRAW in self.algs_present
         has_list = CRUSH_BUCKET_LIST in self.algs_present
+        has_tree = CRUSH_BUCKET_TREE in self.algs_present
         self.straws = jnp.asarray(straws) if has_straw else None
-        self.bucket_ids = jnp.asarray(bids) if has_list else None
+        self.bucket_ids = jnp.asarray(bids) if (has_list or has_tree) \
+            else None
         self.sum_weights = jnp.asarray(sum_weights) if has_list else None
         self.raw_weights = jnp.asarray(raw_weights) if has_list else None
+        self.node_weights = jnp.asarray(node_weights) if has_tree else None
+        self.tree_roots = jnp.asarray(tree_roots) if has_tree else None
+        self.tree_steps = tree_steps
         self.id_to_row = jnp.asarray(i2r)
         self.negln = jnp.asarray(_NEGLN)
         self.max_depth = self._depth(cmap)
@@ -284,6 +309,31 @@ def _list_choose(cm: CompiledCrushMap, row, x, r):
     return jnp.where(found, chosen, items[..., 0])
 
 
+def _tree_choose(cm: CompiledCrushMap, row, x, r):
+    """mapper.c -> bucket_tree_choose: walk the implicit binary tree
+    from the root node (num_nodes >> 1); at node n descend left when
+    (hash32_4(x, n, r, bucket_id) * node_weight(n)) >> 32 falls under
+    the left child's weight.  Unrolled to the deepest tree in the map;
+    terminal (odd) nodes hold their value.  left/right = n -/+ half the
+    lowbit (the height-derived stride)."""
+    nw = cm.node_weights[row]              # (..., NN)
+    n = cm.tree_roots[row]
+    bid = cm.bucket_ids[row].astype(jnp.uint32)
+    for _ in range(cm.tree_steps):
+        half = (n & -n) >> 1
+        left = n - half
+        w = jnp.take_along_axis(nw, n[..., None], axis=-1)[..., 0]
+        h = crush_hash32_4(
+            jnp.asarray(x, jnp.uint32), n.astype(jnp.uint32),
+            jnp.asarray(r, jnp.uint32), bid).astype(jnp.uint64)
+        t = (h * w.astype(jnp.uint64)) >> jnp.uint64(32)
+        wl = jnp.take_along_axis(nw, left[..., None], axis=-1)[..., 0]
+        nxt = jnp.where(t < wl.astype(jnp.uint64), left, n + half)
+        n = jnp.where((n & 1) == 1, n, nxt)
+    return jnp.take_along_axis(cm.items[row], (n >> 1)[..., None],
+                               axis=-1)[..., 0]
+
+
 def _bucket_choose(cm: CompiledCrushMap, row, x, r, pos=0):
     """mapper.c -> crush_bucket_choose over the fused algorithms;
     branches compile only for algorithms present in the map (pure
@@ -299,6 +349,10 @@ def _bucket_choose(cm: CompiledCrushMap, row, x, r, pos=0):
         lc = _list_choose(cm, row, x, r)
         res = lc if res is None else jnp.where(
             cm.algs[row] == CRUSH_BUCKET_LIST, lc, res)
+    if CRUSH_BUCKET_TREE in cm.algs_present:
+        tc = _tree_choose(cm, row, x, r)
+        res = tc if res is None else jnp.where(
+            cm.algs[row] == CRUSH_BUCKET_TREE, tc, res)
     return res
 
 

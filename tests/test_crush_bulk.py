@@ -309,10 +309,10 @@ def test_bulk_chained_overload_holes():
     pin(b, 0, 4, N=200)
 
 
-@pytest.mark.parametrize("alg", ["straw", "list"])
+@pytest.mark.parametrize("alg", ["straw", "list", "tree"])
 def test_bulk_matches_host_legacy_algs(alg):
-    """Legacy straw and list buckets run fused now (tree/uniform stay
-    host-gated); pinned bit-for-bit vs the host mapper."""
+    """Legacy straw, list, and tree buckets run fused now (uniform
+    stays host-gated); pinned bit-for-bit vs the host mapper."""
     b = CrushBuilder()
     b.add_type(1, "host")
     b.add_type(2, "root")
@@ -338,7 +338,9 @@ def test_bulk_matches_host_mixed_algs():
     h1 = b.add_bucket("list", "host", [3, 4], [0x10000, 0x20000])
     h2 = b.add_bucket("straw2", "host", [5, 6, 7],
                       [0x10000, 0x10000, 0x18000])
-    root = b.add_bucket("straw2", "root", [h0, h1, h2])
+    h3 = b.add_bucket("tree", "host", [8, 9, 10],
+                      [0x14000, 0xc000, 0x10000])
+    root = b.add_bucket("straw2", "root", [h0, h1, h2, h3])
     b.add_rule(0, STEPS["chooseleaf_firstn"](root))
     pin(b, 0, 3, N=300)
     w = b.map.device_weights()
@@ -346,8 +348,8 @@ def test_bulk_matches_host_mixed_algs():
     pin(b, 0, 3, N=200, weight=w)
 
 
-def test_bulk_gates_tree_and_uniform():
-    for alg in ("tree", "uniform"):
+def test_bulk_gates_uniform():
+    for alg in ("uniform",):
         b = CrushBuilder()
         b.add_type(1, "host")
         b.add_type(2, "root")
@@ -359,3 +361,25 @@ def test_bulk_gates_tree_and_uniform():
         with pytest.raises(ValueError, match="not fused"):
             bulk.bulk_do_rule(b.map, 0, np.arange(4), 2)
         assert crush_do_rule(b.map, 0, 0, 2)  # host handles them
+
+
+def test_bulk_matches_host_tree_uneven_weights():
+    """Tree walks with non-power-of-two sizes and skewed node weights,
+    pinned bit-for-bit vs the host mapper."""
+    rng = np.random.default_rng(31)
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    hosts = []
+    d = 0
+    for h in range(5):
+        nd = int(rng.integers(1, 6))        # ragged sizes incl. 1
+        ws = [int(w) for w in rng.integers(0x6000, 0x28000, nd)]
+        hosts.append(b.add_bucket("tree", "host",
+                                  list(range(d, d + nd)), ws))
+        d += nd
+    root = b.add_bucket("tree", "root", hosts)
+    b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+    b.add_rule(1, STEPS["chooseleaf_indep"](root))
+    pin(b, 0, 3, N=300)
+    pin(b, 1, 3, N=300)
