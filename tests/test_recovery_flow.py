@@ -82,10 +82,12 @@ def test_osd_failure_recovery_flow():
 
     # marking `dead` out reweights CRUSH, so other slots may have moved
     # too — those shards backfill by plain copy from their live old
-    # home (upstream: recovery vs backfill distinction)
+    # home (upstream: recovery vs backfill distinction). Copy from a
+    # snapshot: new homes may alias other slots' old homes.
+    old_stored = dict(stored)
     for i in range(k + m_coding):
         if i != lost_shard and acting2[i] != acting[i]:
-            stored[acting2[i]] = stored[acting[i]]
+            stored[acting2[i]] = old_stored[acting[i]]
 
     # -- client read after recovery: object reassembles byte-exact ----
     chunks = {i: stored[acting2[i]] for i in range(k)}

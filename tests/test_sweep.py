@@ -1,0 +1,29 @@
+"""Benchmark sweep tool (bench/sweep.py) — grid cells emit one JSON
+line each; impossible profile cells soft-fail with an error field."""
+
+import json
+
+from ceph_tpu.bench.sweep import main
+
+
+def test_sweep_grid_runs(capsys):
+    rc = main(["--plugin", "jerasure", "--plugin", "lrc",
+               "--km", "4,2", "--size", "16384", "--iterations", "1",
+               "--batch", "2"])
+    assert rc == 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    # 4 jerasure techniques + lrc, x encode/decode
+    assert len(lines) == 10
+    ok = [c for c in lines if "gbps" in c]
+    assert len(ok) == 10
+    assert {c["workload"] for c in lines} == {"encode", "decode"}
+
+
+def test_sweep_soft_fails_impossible_cells(capsys):
+    rc = main(["--plugin", "lrc", "--km", "8,3", "--workload", "encode",
+               "--size", "16384", "--iterations", "1", "--batch", "2"])
+    assert rc == 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 1 and "error" in lines[0]

@@ -1,0 +1,107 @@
+"""Thrasher-style property test — qa/suites/rados/thrash-erasure-code*
+analog (SURVEY.md §4 'Integration' row): randomly kill/revive OSDs
+over many epochs while continuously asserting the placement+EC
+invariants the reference's thrashers guard:
+
+- mappings stay deterministic and failure-domain-disjoint,
+- no pg maps to a down/out OSD,
+- every pg keeps >= k live shards (decodability) while no more than m
+  OSDs are down,
+- an object written at epoch 0 stays byte-recoverable at every epoch
+  via minimum_to_decode over the currently-live shards.
+
+The daemons are out of scope (SURVEY §7); this thrashes the math the
+daemons drive."""
+
+import numpy as np
+
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+from ceph_tpu.codes.stripe import StripeInfo, decode, encode
+from ceph_tpu.crush import (
+    CrushBuilder,
+    step_chooseleaf_indep,
+    step_emit,
+    step_take,
+)
+from ceph_tpu.crush.osdmap import IN_WEIGHT, OSDMap, PGPool
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+
+K, M = 4, 2
+N_HOSTS, DEVS = 8, 2
+PG_NUM = 24
+EPOCHS = 30
+
+
+def build():
+    b = CrushBuilder()
+    root = b.build_two_level(N_HOSTS, DEVS)
+    b.add_rule(0, [step_take(root),
+                   step_chooseleaf_indep(K + M, b.type_id("host")),
+                   step_emit()])
+    m = OSDMap(crush=b.map)
+    m.pools[3] = PGPool(pool_id=3, pg_num=PG_NUM, size=K + M,
+                        erasure=True)
+    return m
+
+
+def test_thrash_placement_and_decodability():
+    rng = np.random.default_rng(2024)
+    osdmap = build()
+    reg = ErasureCodePluginRegistry.instance()
+    ec = reg.factory("jerasure", {"technique": "reed_sol_van",
+                                  "k": str(K), "m": str(M)})
+    width = K * ec.get_chunk_size(K * 1024)
+    sinfo = StripeInfo(K, width)
+    obj = rng.integers(0, 256, size=width * 4, dtype=np.uint8).tobytes()
+    shards = encode(sinfo, ec, obj)
+
+    # the object lives in pg 3.7; track which OSD holds which shard
+    ps = 7
+    up0, _, acting0, _ = osdmap.pg_to_up_acting_osds(3, ps)
+    holder = {i: acting0[i] for i in range(K + M)}
+
+    down: set = set()
+    for epoch in range(EPOCHS):
+        # thrash: flip one osd down (or revive), never exceeding m down
+        if down and (len(down) >= M or rng.random() < 0.4):
+            osd = int(rng.choice(sorted(down)))
+            down.discard(osd)
+            osdmap.osd_up[osd] = True
+            osdmap.osd_weight[osd] = IN_WEIGHT
+        else:
+            candidates = [o for o in range(osdmap.max_osd)
+                          if o not in down]
+            osd = int(rng.choice(candidates))
+            down.add(osd)
+            osdmap.mark_down(osd)
+            osdmap.mark_out(osd)
+
+        up_all, _ = osdmap.pg_to_up_bulk(3, engine="host")
+        for pg in range(PG_NUM):
+            members = [int(o) for o in up_all[pg] if o != CRUSH_ITEM_NONE]
+            # determinism
+            again, *_ = osdmap.pg_to_up_acting_osds(3, pg)
+            assert [o for o in again if o != CRUSH_ITEM_NONE] == members
+            # no down osd mapped; failure domains disjoint
+            assert not (set(members) & down)
+            hosts = [o // DEVS for o in members]
+            assert len(hosts) == len(set(hosts))
+            # decodability: >= k shards placeable
+            assert len(members) >= K, f"epoch {epoch} pg {pg}"
+
+        # the epoch-0 object stays recoverable from live shard holders
+        live = {s for s, o in holder.items() if o not in down}
+        assert len(live) >= K
+        want_lost = set(range(K + M)) - live
+        if want_lost:
+            plan = ec.minimum_to_decode(want_lost, live)
+            reads = {s: shards[s] for s in plan}
+            rec = decode(sinfo, ec, reads, want_lost)
+            for s in want_lost:
+                assert rec[s] == shards[s]
+            # recovery re-homes lost shards onto the new up set
+            up_now, _, acting_now, _ = osdmap.pg_to_up_acting_osds(3, ps)
+            for s in want_lost:
+                new_home = acting_now[s]
+                if new_home != CRUSH_ITEM_NONE:
+                    holder[s] = new_home
