@@ -105,15 +105,23 @@ def main() -> int:
         return 0
     # device throughput: chained encodes inside one dispatch; 1024
     # loops (= 64 GiB through the kernel) amortize the ~70 ms tunnel
-    # fetch RTT to <10% of elapsed at the measured rates
-    try:
-        dev = _run(["--device", "jax", "--batch", "64", "--loop", "1024"])
-    except Exception:
-        dev = None
+    # fetch RTT to <10% of elapsed at the measured rates.  Two layouts:
+    # bytes (uint8 contract at the chain boundary) and packed (the
+    # resident uint32 SWAR layout, SURVEY §7 — same bytes, zero
+    # repacking inside the chain).
+    candidates = []
+    for layout in ("packed", "bytes"):
+        try:
+            candidates.append(_run(["--device", "jax", "--batch", "64",
+                                    "--loop", "1024",
+                                    "--layout", layout]))
+        except Exception:
+            pass
     # per-call (includes tunnel dispatch latency), for continuity
     percall = _run(["--device", "jax", "--batch", "64",
                     "--iterations", "100", "--resident"])
-    best = dev if dev and dev["gbps"] > percall["gbps"] else percall
+    candidates.append(percall)
+    best = max(candidates, key=lambda r: r["gbps"])
     out = {
         "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
         "value": round(best["gbps"], 3),
@@ -121,6 +129,7 @@ def main() -> int:
         "vs_baseline": round(best["gbps"] / cpp_gbps, 3),
         "baseline": cpp_src,
         "baseline_gbps": round(cpp_gbps, 3),
+        "layout": best.get("layout", "bytes"),
         "percall_gbps": round(percall["gbps"], 3),
         "vs_numpy": round(best["gbps"] / host["gbps"], 3)
         if host["gbps"] > 0 else None,

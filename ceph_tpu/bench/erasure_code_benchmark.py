@@ -91,6 +91,13 @@ class ErasureCodeBench:
                              "honest number for PCIe-attached deployments "
                              "when the bench host reaches the chip over a "
                              "high-latency tunnel")
+        ap.add_argument("--layout", default="bytes",
+                        choices=["bytes", "packed"],
+                        help="device data layout for --loop encode: "
+                             "'packed' keeps stripes as uint32 SWAR "
+                             "words end to end (the resident layout, "
+                             "SURVEY §7; same bytes, zero repacking "
+                             "inside the chain; w=8 matrix codes only)")
         ap.add_argument("--json", action="store_true", dest="json_out")
         ap.add_argument("--dump-perf", action="store_true",
                         help="print the perf-counter registry (perf "
@@ -103,6 +110,11 @@ class ErasureCodeBench:
             ap.error(f"--iterations {self.args.iterations} must be >= 1")
         if self.args.batch < 1:
             ap.error(f"--batch {self.args.batch} must be >= 1")
+        if self.args.layout == "packed" and not (
+                self.args.workload == "encode" and self.args.loop
+                and self.args.device == "jax"):
+            ap.error("--layout packed applies to the encode --loop "
+                     "--device jax path only")
         self.profile = _parse_parameters(self.args.parameter)
 
     # -- helpers ------------------------------------------------------------
@@ -155,19 +167,30 @@ class ErasureCodeBench:
                 # generation happens before the timer starts
                 n_slabs = min(a.loop, 16)
                 reps = -(-a.loop // n_slabs)
-                gen = jax.jit(lambda d: d[None] ^ jnp.arange(
-                    n_slabs, dtype=jnp.uint8)[:, None, None, None])
-                slabs = gen(jax.device_put(data))
-                np.asarray(slabs[0, 0, 0, :4])  # materialize
+                packed = a.layout == "packed"
+                if packed:
+                    from ceph_tpu.ops.pallas_gf import pack_chunks
+                    staged = jax.device_put(pack_chunks(data))
+                    iota = jnp.arange(n_slabs, dtype=jnp.uint32)[
+                        :, None, None, None, None]
+                    encode_step = ec.encode_chunks_packed_jax
+                else:
+                    staged = jax.device_put(data)
+                    iota = jnp.arange(n_slabs, dtype=jnp.uint8)[
+                        :, None, None, None]
+                    encode_step = ec.encode_chunks_jax
+                gen = jax.jit(lambda d: d[None] ^ iota)
+                slabs = gen(staged)
+                np.asarray(slabs.ravel()[:4])  # materialize
 
                 @jax.jit
                 def chained(slabs):
                     def step(carry, slab):
-                        return carry ^ ec.encode_chunks_jax(slab), None
+                        return carry ^ encode_step(slab), None
 
                     m_ = ec.get_coding_chunk_count()
-                    init = jnp.zeros((slabs.shape[1], m_, slabs.shape[3]),
-                                     jnp.uint8)
+                    init = jnp.zeros((slabs.shape[1], m_)
+                                     + slabs.shape[3:], slabs.dtype)
 
                     def rep(carry, _):
                         c, _ = jax.lax.scan(step, carry, slabs)
@@ -177,10 +200,10 @@ class ErasureCodeBench:
                     return out
 
                 out = chained(slabs)  # compile/warmup
-                np.asarray(out[0, 0, :4])
+                np.asarray(out.ravel()[:4])
                 begin = time.perf_counter()
                 out = chained(slabs)
-                np.asarray(out[0, 0, :4])  # completion barrier
+                np.asarray(out.ravel()[:4])  # completion barrier
                 elapsed = time.perf_counter() - begin
                 total_bytes = in_bytes_per_iter * n_slabs * reps
                 return self._result("encode", elapsed, total_bytes)
@@ -363,6 +386,7 @@ class ErasureCodeBench:
             "iterations": self.args.iterations,
             "size": self.args.size,
             "device": self.args.device,
+            "layout": getattr(self.args, "layout", "bytes"),
             "gbps": gbps,
         }
 

@@ -112,6 +112,29 @@ class MatrixCodeMixin:
         words = jax_words_view(chunks[..., :ns, :], self.w)
         return jax_bytes_view(apply_matrix_best(words, dm_static, self.w))
 
+    # -- packed resident layout (ops/pallas_gf.py pack_chunks form) ------
+
+    def encode_chunks_packed_jax(self, words):
+        """(batch, k, R, 128) uint32 packed device array -> packed
+        parity (batch, m, R, 128).  w=8 only; the fastest layout for
+        device-resident chains (no pack/unpack anywhere)."""
+        if self.w != 8:
+            raise ValueError("packed layout is w=8 only")
+        from ..ops.pallas_gf import apply_matrix_packed_best
+        return apply_matrix_packed_best(words, self._matrix_static)
+
+    def decode_chunks_packed_jax(self, words, available: tuple,
+                                 erased: tuple):
+        """Packed-layout decode: (batch, n_avail, R, 128) uint32 ->
+        (batch, len(erased), R, 128)."""
+        if self.w != 8:
+            raise ValueError("packed layout is w=8 only")
+        if len(available) < self.k:
+            raise IOError(f"need {self.k} chunks, have {len(available)}")
+        from ..ops.pallas_gf import apply_matrix_packed_best
+        _, dm_static, ns = self._decode_matrix(tuple(available), tuple(erased))
+        return apply_matrix_packed_best(words[..., :ns, :, :], dm_static)
+
 
 class BitmatrixCodeMixin:
     """Compute paths for GF(2) bitmatrix codes in jerasure packet layout.

@@ -316,14 +316,25 @@ class OSDMap:
         up_primary (pg_num,)) for every pg of the pool.
 
         Raw placements run through the fused device evaluator
-        (crush/bulk.py, engine="bulk") or the host mapper
+        (crush/bulk.py, engine="bulk"), the same program sharded over
+        every visible device (engine="sharded",
+        parallel/sharded_crush.py), or the host mapper
         (engine="host"); the sparse upmap/affinity layers are then
         applied host-side, mirroring the scalar pipeline exactly.
         pg_temp/primary_temp (the acting overrides) are NOT applied
         here — see pg_to_up_acting_bulk."""
         pool = self.pools[pool_id]
         pps = pool.pps_all()
-        if engine == "bulk":
+        if engine == "sharded":
+            # whole-pool sweep sharded over every visible device
+            from ..parallel.sharded_crush import (default_crush_mesh,
+                                                  sharded_bulk_do_rule)
+            out, cnt = sharded_bulk_do_rule(
+                default_crush_mesh(), self._compiled_map(),
+                pool.crush_rule, pps, pool.size,
+                weight=list(self.osd_weight))
+            raws = [list(out[i, :cnt[i]]) for i in range(pool.pg_num)]
+        elif engine == "bulk":
             from .bulk import bulk_do_rule
             out, cnt = bulk_do_rule(
                 self._compiled_map(), pool.crush_rule, pps, pool.size,

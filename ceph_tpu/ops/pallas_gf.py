@@ -75,10 +75,14 @@ def _unpack_words(words, interpret: bool):
     return parts.astype(jnp.uint8).reshape(words.shape[0] * 4, LANE)
 
 
-def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool):
+def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool,
+                       packed: bool = False):
     """Build the specialized kernel body for a static (r, s) GF(2^8)
     matrix: per input chunk j, walk the xtime doubling chain once and
-    XOR plane t into every accumulator i whose matrix[i][j] has bit t."""
+    XOR plane t into every accumulator i whose matrix[i][j] has bit t.
+
+    packed=True: blocks are already uint32 SWAR words (the resident
+    packed layout) — no register pack/unpack at all."""
 
     def kernel(in_ref, out_ref):
         accs = [None] * r
@@ -87,7 +91,8 @@ def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool):
             top = max((c.bit_length() for c in col), default=0)
             if top == 0:
                 continue
-            plane = _pack_words(in_ref[0, j], interpret)
+            plane = in_ref[0, j] if packed else \
+                _pack_words(in_ref[0, j], interpret)
             for t in range(top):
                 if t > 0:
                     plane = _xtime_swar(plane)
@@ -100,6 +105,8 @@ def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool):
                 if zero is None:
                     zero = jnp.zeros_like(in_ref[0, 0])
                 out_ref[0, i] = zero
+            elif packed:
+                out_ref[0, i] = accs[i]
             else:
                 out_ref[0, i] = _unpack_words(accs[i], interpret)
 
@@ -157,6 +164,81 @@ def apply_matrix_pallas(chunks: jax.Array, matrix_t,
         interpret=interpret,
     )(tiles)
     return out.reshape(lead + (r, c))
+
+
+# -- packed (resident words) layout --------------------------------------
+#
+# SURVEY.md §7 hard-part 3: "keep data in bit-plane layout across
+# encode+decode".  The packed layout is the byte stream viewed as
+# little-endian uint32 words tiled (rows, 128): pack_chunks/unpack_chunks
+# are FREE numpy views on the host, and device arrays staged packed skip
+# the kernel's register pack/unpack entirely — the fastest path for
+# device-resident pipelines (chained encode/decode, the bench --loop
+# mode).  Byte payloads are identical; only the declared dtype/shape
+# differ.
+
+def pack_chunks(chunks: np.ndarray) -> np.ndarray:
+    """(..., s, C) uint8 host array -> (..., s, C/512, 128) uint32 view
+    (no copy; C must satisfy pallas_matrix_supported)."""
+    c = chunks.shape[-1]
+    return np.ascontiguousarray(chunks).view(np.uint32).reshape(
+        chunks.shape[:-1] + (c // (4 * LANE), LANE))
+
+
+def unpack_chunks(words: np.ndarray) -> np.ndarray:
+    """Inverse of pack_chunks: (..., s, R, 128) uint32 -> (..., s, C)."""
+    r = words.shape[-2]
+    return np.ascontiguousarray(words).view(np.uint8).reshape(
+        words.shape[:-2] + (r * 4 * LANE,))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def apply_matrix_pallas_packed(words: jax.Array, matrix_t,
+                               interpret: bool = False) -> jax.Array:
+    """Packed-layout apply: (..., s, R, 128) uint32 -> (..., r, R, 128).
+    Same math as apply_matrix_pallas (w=8), zero layout work."""
+    r = len(matrix_t)
+    s = len(matrix_t[0])
+    assert words.shape[-3] == s and words.dtype == jnp.uint32
+    assert words.shape[-1] == LANE
+    lead = words.shape[:-3]
+    rows = words.shape[-2]
+    rt = _row_tile8(rows * 4) // 4
+    if rt == 0 or rows % rt:
+        rt = rows  # small shapes: one block per chunk
+    b = int(np.prod(lead)) if lead else 1
+    tiles = words.reshape(b, s, rows, LANE)
+    out = pl.pallas_call(
+        _gf8_matrix_kernel(matrix_t, s, r, interpret, packed=True),
+        grid=(b, rows // rt),
+        in_specs=[pl.BlockSpec((1, s, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, r, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, r, rows, LANE), jnp.uint32),
+        interpret=interpret,
+    )(tiles)
+    return out.reshape(lead + (r, rows, LANE))
+
+
+def apply_matrix_packed_best(words: jax.Array, matrix_t) -> jax.Array:
+    """Packed-layout dispatch: the Pallas packed kernel on TPU; on
+    other backends, bitcast to bytes and take the XLA path (CPU has no
+    tiled layouts, so the casts are cheap there).  Byte-identical
+    either way."""
+    if use_pallas():
+        return apply_matrix_pallas_packed(words, matrix_t)
+    from .xla_ops import apply_matrix_xla
+    lead = words.shape[:-3]
+    s, rows = words.shape[-3], words.shape[-2]
+    chunks = jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(
+        lead + (s, rows * 4 * LANE))
+    out = apply_matrix_xla(chunks, matrix_t, 8)
+    r = len(matrix_t)
+    return jax.lax.bitcast_convert_type(
+        out.reshape(lead + (r, rows, LANE, 4)), jnp.uint32)
 
 
 def _bitmatrix_kernel(rows_masks, s: int, w: int, r: int, rt: int):

@@ -3,6 +3,7 @@ truth (ops/regionops.py) in interpreter mode (tests run on CPU; the
 same kernel compiles for TPU and is re-pinned there by the plugin
 round-trips when a TPU backend is present)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -55,6 +56,58 @@ def test_supported_gate():
     assert not pallas_matrix_supported((4, 1000), 8)    # ragged chunk
     assert not pallas_matrix_supported((4, 512), 8)     # rows not tileable
     assert pallas_matrix_supported((4, 128 * 4 * 8), 8)  # minimum tile
+
+
+def test_packed_layout_matches_regionops():
+    from ceph_tpu.ops.pallas_gf import (apply_matrix_pallas_packed,
+                                        pack_chunks, unpack_chunks)
+    rng = np.random.default_rng(17)
+    matrix = rng.integers(0, 256, (3, 8))
+    data = rng.integers(0, 256, (2, 8, 8192), dtype=np.uint8)
+    ref = regionops.matrix_encode(data, matrix, 8)
+    words = pack_chunks(data)
+    # the packed form is a FREE view of the same bytes (README claim)
+    assert np.shares_memory(words, data)
+    assert np.array_equal(unpack_chunks(words), data)
+    got = np.asarray(apply_matrix_pallas_packed(
+        words, matrix_to_static(matrix), True))
+    assert np.array_equal(unpack_chunks(got), ref)
+
+
+def test_packed_dispatcher_cpu_fallback():
+    """On CPU apply_matrix_packed_best takes the XLA path through
+    bitcasts; bytes still match the host reference."""
+    from ceph_tpu.ops.pallas_gf import (apply_matrix_packed_best,
+                                        pack_chunks, unpack_chunks)
+    rng = np.random.default_rng(19)
+    matrix = rng.integers(0, 256, (2, 4))
+    data = rng.integers(0, 256, (3, 4, 4096), dtype=np.uint8)
+    ref = regionops.matrix_encode(data, matrix, 8)
+    got = np.asarray(apply_matrix_packed_best(
+        jnp.asarray(pack_chunks(data)), matrix_to_static(matrix)))
+    assert np.array_equal(unpack_chunks(got), ref)
+
+
+def test_packed_plugin_roundtrip_cpu():
+    """encode/decode_chunks_packed_jax through the plugin mixin: parity
+    and reconstruction agree with the bytes-layout paths."""
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    from ceph_tpu.ops.pallas_gf import pack_chunks, unpack_chunks
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    rng = np.random.default_rng(23)
+    data = rng.integers(0, 256, (2, 4, 4096), dtype=np.uint8)
+    parity_ref = np.asarray(ec.encode_chunks_batch(data))
+    packed = jnp.asarray(pack_chunks(data))
+    parity = unpack_chunks(np.asarray(ec.encode_chunks_packed_jax(packed)))
+    assert np.array_equal(parity, parity_ref)
+    # decode chunk 1 from survivors (0,2,3,4)
+    allc = np.concatenate([data, parity_ref], axis=1)
+    avail = (0, 2, 3, 4)
+    packed_avail = jnp.asarray(pack_chunks(allc[:, list(avail), :]))
+    rec = unpack_chunks(np.asarray(
+        ec.decode_chunks_packed_jax(packed_avail, avail, (1,))))
+    assert np.array_equal(rec[:, 0, :], data[:, 1, :])
 
 
 def test_dispatcher_fallback_matches_on_cpu():
