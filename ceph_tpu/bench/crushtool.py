@@ -71,6 +71,8 @@ def main(argv=None) -> int:
     p.add_argument("--max-x", type=int, default=1023)
     p.add_argument("--show-statistics", action="store_true")
     p.add_argument("--show-mappings", action="store_true")
+    p.add_argument("--show-utilization", action="store_true",
+                   help="per-device actual vs weight-expected placements")
     p.add_argument("--engine", choices=("host", "bulk"), default="bulk")
     p.add_argument("--weight", nargs=2, action="append", default=[],
                    metavar=("DEV", "W"),
@@ -128,7 +130,13 @@ def main(argv=None) -> int:
             for i, row in enumerate(res.mappings):
                 devs = [int(d) for d in row if d != CRUSH_ITEM_NONE]
                 print(f"CRUSH rule {args.rule} x {args.min_x + i} {devs}")
-        if args.show_statistics or not args.show_mappings:
+        if args.show_utilization:
+            from ..crush.balancer import osd_crush_weights
+            print(res.utilization_report(
+                [int(w) for w in osd_crush_weights(cmap)],
+                reweights=weight))
+        if args.show_statistics or not (args.show_mappings
+                                        or args.show_utilization):
             print(res.report())
     return 0
 

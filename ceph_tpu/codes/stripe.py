@@ -241,6 +241,50 @@ def encode(sinfo: StripeInfo, ec, data: bytes,
     return out
 
 
+def overwrite(sinfo: StripeInfo, ec, shards: Dict[int, bytes],
+              offset: int, data: bytes) -> Dict[int, bytes]:
+    """ECBackend read-modify-write math (ECTransaction::
+    generate_transactions → the RMW path, SURVEY.md §3.3): apply a
+    logical overwrite at ``offset`` to an encoded object.
+
+    The touched stripe range is rounded to stripe bounds
+    (offset_len_to_stripe_bounds), the old bytes of that range are
+    reassembled from the data shards, merged with ``data``, re-encoded
+    in one batched call, and spliced back — returning the full new
+    shard set.  Shards outside the touched chunk range are unchanged
+    (byte-wise), mirroring how the reference writes only the affected
+    shard extents."""
+    k = ec.get_data_chunk_count()
+    mapping = _chunk_mapping(ec)
+    lengths = {len(v) for v in shards.values()}
+    if len(lengths) != 1:
+        raise ValueError("uneven shard buffers")
+    shard_len = lengths.pop()
+    obj_len = shard_len // sinfo.chunk_size * sinfo.stripe_width
+    if offset + len(data) > obj_len:
+        raise ValueError("overwrite past object end")
+    start, length = sinfo.offset_len_to_stripe_bounds(offset, len(data))
+    n_stripes = length // sinfo.stripe_width
+    c0 = sinfo.logical_to_prev_chunk_offset(start)
+    c1 = c0 + n_stripes * sinfo.chunk_size
+
+    # reassemble the old logical bytes of the touched range from the
+    # data shards (one reshape, same layout math as encode/decode),
+    # merge, re-encode through the validating encode()
+    old = np.stack([
+        np.frombuffer(shards[mapping[i]][c0:c1], np.uint8).reshape(
+            n_stripes, sinfo.chunk_size)
+        for i in range(k)], axis=1)
+    merged = bytearray(old.tobytes())
+    lo = offset - start
+    merged[lo:lo + len(data)] = data
+    sub = encode(sinfo, ec, bytes(merged))
+    out = {}
+    for shard_id, buf in shards.items():
+        out[shard_id] = buf[:c0] + sub[shard_id] + buf[c1:]
+    return out
+
+
 def decode(sinfo: StripeInfo, ec, to_decode: Dict[int, bytes],
            want_to_read: Iterable[int]) -> Dict[int, bytes]:
     """ECUtil.cc → ECUtil::decode: surviving shard buffers → wanted

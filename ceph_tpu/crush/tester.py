@@ -50,6 +50,29 @@ class TestResult:
         lines.append(f"  bad mappings: {self.bad_mappings}")
         return "\n".join(lines)
 
+    def utilization_report(self, crush_weights: Sequence[int],
+                           reweights: Optional[Sequence[int]] = None
+                           ) -> str:
+        """crushtool --show-utilization style output: per-device actual
+        vs expected placements.  Expected share = crush hierarchy
+        weight x the reweight fraction actually applied to the run
+        (Ceph's effective capacity: crush weight x reweight)."""
+        eff = []
+        for dev, w in enumerate(crush_weights):
+            rw = reweights[dev] if reweights and dev < len(reweights) \
+                else 0x10000
+            eff.append(max(w, 0) * min(max(rw, 0), 0x10000) / 0x10000)
+        total_w = sum(eff) or 1
+        placed = sum(self.device_counts.values())
+        lines = []
+        for dev, w in enumerate(eff):
+            n = self.device_counts.get(dev, 0)
+            expected = placed * w / total_w
+            ratio = n / expected if expected else float("inf") if n else 1.0
+            lines.append(f"  device {dev}:\tstored {n}\texpected "
+                         f"{expected:.1f}\t[{ratio:.2f}]")
+        return "\n".join(lines)
+
 
 def test_rule(cmap: CrushMap, ruleno: int, num_rep: int,
               min_x: int = 0, max_x: int = 1023,

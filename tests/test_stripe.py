@@ -123,6 +123,48 @@ def test_encode_want_filters_shards():
     assert set(shards) == {4, 5}
 
 
+@pytest.mark.parametrize("off,length", [
+    (0, 100),            # head, sub-stripe
+    (5000, 3000),        # unaligned middle span
+    (4096 * 4, 4096 * 4),  # exactly one stripe
+    (4096 * 4 * 5 - 7, 7),  # tail
+])
+def test_overwrite_rmw_matches_full_reencode(off, length):
+    """ECBackend RMW path: splice-overwrite == encode of the mutated
+    object, byte for byte, and untouched shard extents are unchanged."""
+    from ceph_tpu.codes.stripe import overwrite
+    ec = make_ec("jerasure", k=4, m=2, technique="reed_sol_van")
+    width = 4 * ec.get_chunk_size(4 * 4096)
+    sinfo = StripeInfo(4, width)
+    rng = np.random.default_rng(3)
+    obj = bytearray(rng.integers(0, 256, size=width * 5,
+                                 dtype=np.uint8).tobytes())
+    shards = encode(sinfo, ec, bytes(obj))
+    patch = rng.integers(0, 256, size=length, dtype=np.uint8).tobytes()
+
+    new_shards = overwrite(sinfo, ec, shards, off, patch)
+    obj[off:off + length] = patch
+    expect = encode(sinfo, ec, bytes(obj))
+    assert new_shards == expect
+    # untouched stripes' shard bytes are bit-identical to the originals
+    start, span = sinfo.offset_len_to_stripe_bounds(off, length)
+    c0 = sinfo.logical_to_prev_chunk_offset(start)
+    c1 = c0 + (span // sinfo.stripe_width) * sinfo.chunk_size
+    for s in range(6):
+        assert new_shards[s][:c0] == shards[s][:c0]
+        assert new_shards[s][c1:] == shards[s][c1:]
+
+
+def test_overwrite_rejects_past_end():
+    from ceph_tpu.codes.stripe import overwrite
+    ec = make_ec("jerasure", k=4, m=2, technique="reed_sol_van")
+    width = 4 * ec.get_chunk_size(4 * 4096)
+    sinfo = StripeInfo(4, width)
+    shards = encode(sinfo, ec, bytes(width))
+    with pytest.raises(ValueError):
+        overwrite(sinfo, ec, shards, width - 3, b"xxxx")
+
+
 def test_recovery_op_walkthrough():
     """ECBackend::continue_recovery_op math: a shard OSD dies; the
     primary reads minimum_to_decode from survivors, reconstructs the

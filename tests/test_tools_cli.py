@@ -72,6 +72,17 @@ def test_osdmaptool_overrides_affect_mapping(tmp_path):
     assert "osd.0\t0" in r.stdout       # out+down osd takes nothing
 
 
+def test_crushtool_show_utilization():
+    r = run("ceph_tpu.bench.crushtool", "--build-two-level", "3", "2",
+            "--test", "--engine", "host", "--max-x", "199",
+            "--show-utilization")
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.splitlines() if "stored" in l]
+    assert len(lines) == 6
+    stored = sum(int(l.split()[3]) for l in lines)
+    assert stored == 200 * 3           # every placement accounted for
+
+
 def test_osdmaptool_requires_action(tmp_path):
     mapfn = str(tmp_path / "map.json")
     run("ceph_tpu.bench.osdmaptool", "--createsimple", "3", "-o", mapfn)
