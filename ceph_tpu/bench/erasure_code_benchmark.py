@@ -93,11 +93,12 @@ class ErasureCodeBench:
                              "high-latency tunnel")
         ap.add_argument("--layout", default="bytes",
                         choices=["bytes", "packed"],
-                        help="device data layout for --loop encode: "
-                             "'packed' keeps stripes as uint32 SWAR "
-                             "words end to end (the resident layout, "
-                             "SURVEY §7; same bytes, zero repacking "
-                             "inside the chain; w=8 matrix codes only)")
+                        help="device data layout for the --loop encode/"
+                             "decode chains: 'packed' keeps stripes as "
+                             "uint32 SWAR words end to end (the "
+                             "resident layout, SURVEY §7; same bytes, "
+                             "zero repacking inside the chain; w=8 "
+                             "matrix codes only)")
         ap.add_argument("--json", action="store_true", dest="json_out")
         ap.add_argument("--dump-perf", action="store_true",
                         help="print the perf-counter registry (perf "
@@ -111,13 +112,25 @@ class ErasureCodeBench:
         if self.args.batch < 1:
             ap.error(f"--batch {self.args.batch} must be >= 1")
         if self.args.layout == "packed" and not (
-                self.args.workload == "encode" and self.args.loop
-                and self.args.device == "jax"):
-            ap.error("--layout packed applies to the encode --loop "
-                     "--device jax path only")
+                self.args.loop and self.args.device == "jax"):
+            ap.error("--layout packed applies to the --loop "
+                     "--device jax paths only")
         self.profile = _parse_parameters(self.args.parameter)
 
     # -- helpers ------------------------------------------------------------
+
+    def _check_packed(self, ec) -> None:
+        """--layout packed needs the w=8 matrix-code packed methods
+        (techniques.MatrixCodeMixin); fail as a clean CLI error before
+        any expensive warmup."""
+        attr = ("encode_chunks_packed_jax"
+                if self.args.workload == "encode"
+                else "decode_chunks_packed_jax")
+        if not hasattr(ec, attr):
+            raise SystemExit(
+                f"ceph_erasure_code_benchmark: error: --layout packed "
+                f"is not supported by plugin {self.args.plugin!r} with "
+                f"this profile (w=8 matrix codes only)")
 
     def _instance(self):
         registry = ErasureCodePluginRegistry.instance()
@@ -169,6 +182,7 @@ class ErasureCodeBench:
                 reps = -(-a.loop // n_slabs)
                 packed = a.layout == "packed"
                 if packed:
+                    self._check_packed(ec)
                     from ceph_tpu.ops.pallas_gf import pack_chunks
                     staged = jax.device_put(pack_chunks(data))
                     iota = jnp.arange(n_slabs, dtype=jnp.uint32)[
@@ -311,20 +325,31 @@ class ErasureCodeBench:
             n_slabs = min(a.loop, 8)
             reps = -(-a.loop // n_slabs)
             avail_idx = np.array(available)
-            gen = jax.jit(lambda d: (d[None] ^ jnp.arange(
-                n_slabs, dtype=jnp.uint8)[:, None, None, None]
-            )[:, :, avail_idx, :])
-            slabs = gen(jax.device_put(allchunks))
-            np.asarray(slabs[0, 0, 0, :4])  # materialize
+            packed = a.layout == "packed"
+            if packed:
+                self._check_packed(ec)
+                from ceph_tpu.ops.pallas_gf import pack_chunks
+                staged = jax.device_put(pack_chunks(allchunks))
+                iota = jnp.arange(n_slabs, dtype=jnp.uint32)[
+                    :, None, None, None, None]
+                decode_step = ec.decode_chunks_packed_jax
+            else:
+                staged = jax.device_put(allchunks)
+                iota = jnp.arange(n_slabs, dtype=jnp.uint8)[
+                    :, None, None, None]
+                decode_step = ec.decode_chunks_jax
+            gen = jax.jit(lambda d: (d[None] ^ iota)[:, :, avail_idx])
+            slabs = gen(staged)
+            np.asarray(slabs.ravel()[:4])  # materialize
 
             @jax.jit
             def chained(slabs):
                 def step(carry, slab):
-                    out = ec.decode_chunks_jax(slab, available, pat)
+                    out = decode_step(slab, available, pat)
                     return carry ^ out, None
 
-                init = jnp.zeros((allchunks.shape[0], len(pat),
-                                  allchunks.shape[2]), jnp.uint8)
+                init = jnp.zeros((allchunks.shape[0], len(pat))
+                                 + slabs.shape[3:], slabs.dtype)
 
                 def rep(carry, _):
                     c, _ = jax.lax.scan(step, carry, slabs)

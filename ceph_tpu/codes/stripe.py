@@ -260,6 +260,8 @@ def overwrite(sinfo: StripeInfo, ec, shards: Dict[int, bytes],
     if len(lengths) != 1:
         raise ValueError("uneven shard buffers")
     shard_len = lengths.pop()
+    if shard_len % sinfo.chunk_size:
+        raise ValueError("shard length not chunk-aligned")
     obj_len = shard_len // sinfo.chunk_size * sinfo.stripe_width
     if offset + len(data) > obj_len:
         raise ValueError("overwrite past object end")

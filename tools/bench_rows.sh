@@ -1,0 +1,57 @@
+#!/bin/sh
+# Measure the BASELINE.md table rows on the live device (BASELINE.md §
+# rows 3-5 + the north star in both layouts). Run from the repo root on
+# a machine with the TPU reachable; each command prints one JSON line /
+# statistics block. Results go into BASELINE.md ("Measured on chip"
+# notes) and the round's BENCH notes.
+#
+# The axon tunnel wedges at times (see bench.py _device_reachable);
+# probe first:
+#   timeout 100 python -c "import jax; print(len(jax.devices()))"
+set -e
+
+echo "== north star encode, bytes layout (BASELINE row *) =="
+python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<20)) --batch 64 --loop 1024 --json
+
+echo "== north star encode, packed resident layout =="
+python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<20)) --batch 64 --loop 1024 --layout packed --json
+
+echo "== row 3: shec k=6 m=3 c=2 single-chunk decode =="
+python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p shec -P k=6 -P m=3 -P c=2 -s $((6*131072)) \
+    --workload decode -e 1 --batch 32 --loop 256 --json
+
+echo "== row 4: clay k=8 m=4 d=11 decode (1 erasure) =="
+python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p clay -P k=8 -P m=4 -P d=11 -s $((1<<20)) \
+    --workload decode -e 1 --batch 16 --loop 64 --json
+
+echo "== row 4b: jerasure RS decode, packed layout =="
+python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<20)) --workload decode -e 2 --batch 64 --loop 1024 \
+    --layout packed --json
+
+echo "== row 5: 1M-PG bulk CRUSH sweep on device =="
+python - <<'EOF'
+import json, time
+import numpy as np
+from ceph_tpu.crush.builder import CrushBuilder
+from ceph_tpu.crush import bulk
+
+b = CrushBuilder()
+root = b.build_two_level(8, 4)
+b.add_simple_rule(0, root, "host", firstn=True)
+xs = np.arange(1_000_000)
+out, cnt = bulk.bulk_do_rule(b.map, 0, xs[:1024], 3)   # warm/compile
+t0 = time.perf_counter()
+out, cnt = bulk.bulk_do_rule(b.map, 0, xs, 3)
+dt = time.perf_counter() - t0
+print(json.dumps({"metric": "bulk_crush_mappings_per_s",
+                  "value": round(len(xs) / dt), "unit": "mappings/s",
+                  "n": len(xs), "seconds": round(dt, 3)}))
+EOF
