@@ -122,11 +122,19 @@ class ErasureCodeBench:
     def _check_packed(self, ec) -> None:
         """--layout packed needs the w=8 matrix-code packed methods
         (techniques.MatrixCodeMixin); fail as a clean CLI error before
-        any expensive warmup."""
+        any expensive warmup.  A plugin that overrides the bytes-layout
+        jax method (shec's plan-based decode) has semantics the
+        inherited mixin packed method would bypass — rejected too."""
+        from ..codes.techniques import MatrixCodeMixin
         attr = ("encode_chunks_packed_jax"
                 if self.args.workload == "encode"
                 else "decode_chunks_packed_jax")
-        if not hasattr(ec, attr):
+        base_attr = attr.replace("_packed", "")
+        ok = (hasattr(ec, attr)
+              and getattr(ec, "w", None) == 8
+              and getattr(type(ec), base_attr, None)
+              is getattr(MatrixCodeMixin, base_attr, None))
+        if not ok:
             raise SystemExit(
                 f"ceph_erasure_code_benchmark: error: --layout packed "
                 f"is not supported by plugin {self.args.plugin!r} with "

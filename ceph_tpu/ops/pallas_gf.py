@@ -28,6 +28,9 @@ region math is byte-local, so any fixed bijection is exact.
 - Bitmatrix codes (cauchy_*, liberation, blaum_roth, liber8tion, shec)
   are pure packet XOR — no word packing at all; their kernel stays in
   uint8 throughout.
+- w=16/32 matrix codes run through a separate word kernel on the
+  uint16/uint32 word views (elements must stay whole inside SWAR
+  registers; the byte kernel's strided packing is w=8-only).
 
 Byte-identity: pinned against ops/regionops.py (the host ground truth)
 in tests/test_pallas.py, in interpreter mode on CPU and compiled on TPU.
@@ -43,9 +46,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# the one SWAR doubling primitive, shared with the XLA path so the two
-# engines can never diverge
-from .xla_ops import xtime_swar8 as _xtime_swar
+# the SWAR doubling primitives, shared with the XLA path so the two
+# engines can never diverge; the kernel's little-endian sublane packing
+# keeps multi-byte field elements (w=16/32) contiguous per word
+from .xla_ops import xtime_swar as _xtime_swar
 
 LANE = 128            # TPU lane width
 SUBLANE_U8 = 32       # uint8 VMEM tile is (32, 128)
@@ -81,6 +85,11 @@ def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool,
     matrix: per input chunk j, walk the xtime doubling chain once and
     XOR plane t into every accumulator i whose matrix[i][j] has bit t.
 
+    w=8 ONLY: the register pack groups bytes strided by 128 lanes,
+    which is exact for byte-local GF(2^8) math but would split the
+    multi-byte field elements of w=16/32 (those use the word kernel
+    below, which receives whole elements per sublane).
+
     packed=True: blocks are already uint32 SWAR words (the resident
     packed layout) — no register pack/unpack at all."""
 
@@ -95,7 +104,7 @@ def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool,
                 _pack_words(in_ref[0, j], interpret)
             for t in range(top):
                 if t > 0:
-                    plane = _xtime_swar(plane)
+                    plane = _xtime_swar(plane, 8)
                 for i in range(r):
                     if (col[i] >> t) & 1:
                         accs[i] = plane if accs[i] is None else accs[i] ^ plane
@@ -124,9 +133,10 @@ def _row_tile8(rows: int) -> int:
 
 
 def pallas_matrix_supported(shape, w: int) -> bool:
-    """True when (..., s, C) uint8 chunks fit the kernel's tiling: w=8
-    and C a multiple of 32*128 bytes (every SIMD-aligned chunk size
-    >= 4 KiB qualifies; others fall back to the XLA path)."""
+    """True when (..., s, C) uint8 chunks fit the byte kernel's
+    tiling: w=8 and C a multiple of 32*128 bytes (every SIMD-aligned
+    chunk size >= 4 KiB qualifies; others fall back to the XLA path or
+    the word kernel)."""
     if w != 8 or len(shape) < 2:
         return False
     c = shape[-1]
@@ -138,8 +148,8 @@ def pallas_matrix_supported(shape, w: int) -> bool:
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def apply_matrix_pallas(chunks: jax.Array, matrix_t,
                         interpret: bool = False) -> jax.Array:
-    """Apply a static (r, s) GF(2^8) matrix to (..., s, C) uint8 chunks
-    -> (..., r, C) parity/decode output.  Same contract as
+    """Apply a static (r, s) GF(2^8) matrix to (..., s, C) uint8
+    chunks -> (..., r, C) parity/decode output.  Same contract as
     xla_ops.apply_matrix_xla (w=8); caller gates on
     pallas_matrix_supported."""
     r = len(matrix_t)
@@ -164,6 +174,112 @@ def apply_matrix_pallas(chunks: jax.Array, matrix_t,
         interpret=interpret,
     )(tiles)
     return out.reshape(lead + (r, c))
+
+
+# -- w=16/32 word kernel -------------------------------------------------
+#
+# Multi-byte field elements must stay whole inside the SWAR registers,
+# so this kernel takes the w-bit WORD view (uint16/uint32 — a free
+# numpy view on the host; the plugin mixins already pass it).  u16
+# tiles bitcast in-registers to u32 pairs of complete elements; u32
+# elements are SWAR words as-is.
+
+_WORD_DTYPE = {16: jnp.uint16, 32: jnp.uint32}
+_WORD_SUBLANE = {16: 16, 32: 8}   # native VMEM tile sublane counts
+
+
+def _gfw_matrix_kernel(matrix_t, s: int, r: int, w: int, interpret: bool):
+    def pack(tile):
+        if w == 32:
+            return tile
+        if not interpret:
+            return pltpu.bitcast(tile, jnp.uint32)
+        half = tile.reshape(tile.shape[0] // 2, 2, LANE).astype(jnp.uint32)
+        return half[:, 0] | (half[:, 1] << 16)
+
+    def unpack(words):
+        if w == 32:
+            return words
+        if not interpret:
+            return pltpu.bitcast(words, jnp.uint16)
+        parts = jnp.stack([words & 0xFFFF, words >> 16], axis=1)
+        return parts.astype(jnp.uint16).reshape(words.shape[0] * 2, LANE)
+
+    def kernel(in_ref, out_ref):
+        accs = [None] * r
+        for j in range(s):
+            col = [matrix_t[i][j] for i in range(r)]
+            top = max((c.bit_length() for c in col), default=0)
+            if top == 0:
+                continue
+            plane = pack(in_ref[0, j])
+            for t in range(top):
+                if t > 0:
+                    plane = _xtime_swar(plane, w)
+                for i in range(r):
+                    if (col[i] >> t) & 1:
+                        accs[i] = plane if accs[i] is None else accs[i] ^ plane
+        zero = None
+        for i in range(r):
+            if accs[i] is None:
+                if zero is None:
+                    zero = jnp.zeros_like(in_ref[0, 0])
+                out_ref[0, i] = zero
+            else:
+                out_ref[0, i] = unpack(accs[i])
+
+    return kernel
+
+
+def _row_tile_words(rows: int, w: int) -> int:
+    sub = _WORD_SUBLANE[w]
+    for cand in range(MAX_ROW_TILE8 // (w // 8), sub - 1, -sub):
+        if cand <= rows and rows % cand == 0:
+            return cand
+    return 0
+
+
+def pallas_matrix_words_supported(shape, w: int) -> bool:
+    """(..., s, Ce) word arrays whose element rows tile the word
+    dtype's native VMEM sublanes."""
+    if w not in (16, 32) or len(shape) < 2:
+        return False
+    ce = shape[-1]
+    if ce % LANE != 0:
+        return False
+    return _row_tile_words(ce // LANE, w) != 0
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def apply_matrix_pallas_words(words: jax.Array, matrix_t, w: int,
+                              interpret: bool = False) -> jax.Array:
+    """Apply a static (r, s) GF(2^w) matrix (w=16/32) to (..., s, Ce)
+    w-bit word arrays -> (..., r, Ce).  Same contract as
+    xla_ops.apply_matrix_xla on word views; caller gates on
+    pallas_matrix_words_supported."""
+    r = len(matrix_t)
+    s = len(matrix_t[0])
+    assert words.shape[-2] == s and words.dtype == _WORD_DTYPE[w]
+    lead = words.shape[:-2]
+    ce = words.shape[-1]
+    rows = ce // LANE
+    rt = _row_tile_words(rows, w)
+    b = int(np.prod(lead)) if lead else 1
+    tiles = words.reshape(b, s, rows, LANE)
+    out = pl.pallas_call(
+        _gfw_matrix_kernel(matrix_t, s, r, w, interpret),
+        grid=(b, rows // rt),
+        in_specs=[pl.BlockSpec((1, s, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, r, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, r, rows, LANE),
+                                       _WORD_DTYPE[w]),
+        interpret=interpret,
+    )(tiles)
+    return out.reshape(lead + (r, ce))
 
 
 # -- packed (resident words) layout --------------------------------------
@@ -330,12 +446,21 @@ def use_pallas() -> bool:
 
 
 def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
-    """Dispatch: Pallas kernel on TPU for supported w=8 shapes, XLA
-    otherwise.  Byte-identical either way (cross-pinned in tests)."""
+    """Dispatch over the engines, byte-identical in every branch
+    (cross-pinned in tests):
+
+    - w=8, uint8 in: the byte Pallas kernel on TPU, XLA otherwise.
+    - w=16/32, word-typed in (uint16/uint32 views — what the plugin
+      mixins pass): the word Pallas kernel on TPU, XLA otherwise.
+    """
     from .xla_ops import apply_matrix_xla
     if (w == 8 and chunks.dtype == jnp.uint8 and use_pallas()
             and pallas_matrix_supported(chunks.shape, w)):
         return apply_matrix_pallas(chunks, matrix_t)
+    if (w in (16, 32) and chunks.dtype == _WORD_DTYPE.get(w)
+            and use_pallas()
+            and pallas_matrix_words_supported(chunks.shape, w)):
+        return apply_matrix_pallas_words(chunks, matrix_t, w)
     return apply_matrix_xla(chunks, matrix_t, w)
 
 

@@ -62,6 +62,33 @@ def xtime_swar8(v: jax.Array) -> jax.Array:
 _xtime_swar8 = xtime_swar8
 
 
+def xtime_swar16(v: jax.Array) -> jax.Array:
+    """xtime on uint32 lanes each packing 2 independent GF(2^16)
+    halfwords (little-endian within the byte stream, matching the
+    Pallas kernel's sublane packing)."""
+    hi = v & jnp.uint32(0x80008000)
+    return ((v ^ hi) << jnp.uint32(1)) ^ (
+        (hi >> jnp.uint32(15)) * jnp.uint32(DEFAULT_POLY[16] & 0xFFFF))
+
+
+def xtime_swar32(v: jax.Array) -> jax.Array:
+    """xtime on uint32 lanes, one GF(2^32) word per lane."""
+    hi = v & jnp.uint32(0x80000000)
+    return ((v ^ hi) << jnp.uint32(1)) ^ (
+        (hi >> jnp.uint32(31)) * jnp.uint32(DEFAULT_POLY[32] & 0xFFFFFFFF))
+
+
+def xtime_swar(v: jax.Array, w: int) -> jax.Array:
+    """Dispatch: xtime over uint32 SWAR words for w in {8, 16, 32}."""
+    if w == 8:
+        return xtime_swar8(v)
+    if w == 16:
+        return xtime_swar16(v)
+    if w == 32:
+        return xtime_swar32(v)
+    raise ValueError(f"no SWAR xtime for w={w}")
+
+
 from ..gf.gf8 import GF8_POLY
 
 GF8_FEEDBACK = GF8_POLY & 0xFF  # 0x1d
