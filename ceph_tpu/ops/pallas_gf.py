@@ -79,19 +79,12 @@ def _unpack_words(words, interpret: bool):
     return parts.astype(jnp.uint8).reshape(words.shape[0] * 4, LANE)
 
 
-def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool,
-                       packed: bool = False):
-    """Build the specialized kernel body for a static (r, s) GF(2^8)
-    matrix: per input chunk j, walk the xtime doubling chain once and
-    XOR plane t into every accumulator i whose matrix[i][j] has bit t.
-
-    w=8 ONLY: the register pack groups bytes strided by 128 lanes,
-    which is exact for byte-local GF(2^8) math but would split the
-    multi-byte field elements of w=16/32 (those use the word kernel
-    below, which receives whole elements per sublane).
-
-    packed=True: blocks are already uint32 SWAR words (the resident
-    packed layout) — no register pack/unpack at all."""
+def _matrix_kernel(matrix_t, s: int, r: int, w: int, pack, unpack):
+    """Build THE specialized kernel body shared by every matrix-code
+    variant — byte w=8, packed resident, and w=16/32 word layouts pass
+    their own register pack/unpack pair: per input chunk j, walk the
+    xtime doubling chain once and XOR plane t into every accumulator i
+    whose matrix[i][j] has bit t."""
 
     def kernel(in_ref, out_ref):
         accs = [None] * r
@@ -100,11 +93,10 @@ def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool,
             top = max((c.bit_length() for c in col), default=0)
             if top == 0:
                 continue
-            plane = in_ref[0, j] if packed else \
-                _pack_words(in_ref[0, j], interpret)
+            plane = pack(in_ref[0, j])
             for t in range(top):
                 if t > 0:
-                    plane = _xtime_swar(plane, 8)
+                    plane = _xtime_swar(plane, w)
                 for i in range(r):
                     if (col[i] >> t) & 1:
                         accs[i] = plane if accs[i] is None else accs[i] ^ plane
@@ -114,22 +106,41 @@ def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool,
                 if zero is None:
                     zero = jnp.zeros_like(in_ref[0, 0])
                 out_ref[0, i] = zero
-            elif packed:
-                out_ref[0, i] = accs[i]
             else:
-                out_ref[0, i] = _unpack_words(accs[i], interpret)
+                out_ref[0, i] = unpack(accs[i])
 
     return kernel
 
 
-def _row_tile8(rows: int) -> int:
-    """Largest multiple of 32 (the u8 VMEM tile sublane count) that
-    divides ``rows``, capped at MAX_ROW_TILE8; 0 when none exists
+def _gf8_matrix_kernel(matrix_t, s: int, r: int, interpret: bool,
+                       packed: bool = False):
+    """w=8 kernel body.  The register pack groups bytes strided by 128
+    lanes — exact for byte-local GF(2^8) math but unusable for w=16/32
+    (their elements would split; those use _gfw_matrix_kernel, which
+    receives whole elements per sublane).  packed=True: blocks are
+    already uint32 SWAR words (the resident layout) — identity
+    pack/unpack."""
+    ident = lambda v: v  # noqa: E731
+    if packed:
+        return _matrix_kernel(matrix_t, s, r, 8, ident, ident)
+    return _matrix_kernel(
+        matrix_t, s, r, 8,
+        lambda v: _pack_words(v, interpret),
+        lambda v: _unpack_words(v, interpret))
+
+
+def _row_tile(rows: int, sublane: int, cap: int) -> int:
+    """Largest multiple of ``sublane`` (the dtype's native VMEM tile
+    sublane count) that divides ``rows``, capped; 0 when none exists
     (caller falls back to XLA)."""
-    for cand in range(MAX_ROW_TILE8, SUBLANE_U8 - 1, -SUBLANE_U8):
+    for cand in range(cap, sublane - 1, -sublane):
         if cand <= rows and rows % cand == 0:
             return cand
     return 0
+
+
+def _row_tile8(rows: int) -> int:
+    return _row_tile(rows, SUBLANE_U8, MAX_ROW_TILE8)
 
 
 def pallas_matrix_supported(shape, w: int) -> bool:
@@ -205,38 +216,11 @@ def _gfw_matrix_kernel(matrix_t, s: int, r: int, w: int, interpret: bool):
         parts = jnp.stack([words & 0xFFFF, words >> 16], axis=1)
         return parts.astype(jnp.uint16).reshape(words.shape[0] * 2, LANE)
 
-    def kernel(in_ref, out_ref):
-        accs = [None] * r
-        for j in range(s):
-            col = [matrix_t[i][j] for i in range(r)]
-            top = max((c.bit_length() for c in col), default=0)
-            if top == 0:
-                continue
-            plane = pack(in_ref[0, j])
-            for t in range(top):
-                if t > 0:
-                    plane = _xtime_swar(plane, w)
-                for i in range(r):
-                    if (col[i] >> t) & 1:
-                        accs[i] = plane if accs[i] is None else accs[i] ^ plane
-        zero = None
-        for i in range(r):
-            if accs[i] is None:
-                if zero is None:
-                    zero = jnp.zeros_like(in_ref[0, 0])
-                out_ref[0, i] = zero
-            else:
-                out_ref[0, i] = unpack(accs[i])
-
-    return kernel
+    return _matrix_kernel(matrix_t, s, r, w, pack, unpack)
 
 
 def _row_tile_words(rows: int, w: int) -> int:
-    sub = _WORD_SUBLANE[w]
-    for cand in range(MAX_ROW_TILE8 // (w // 8), sub - 1, -sub):
-        if cand <= rows and rows % cand == 0:
-            return cand
-    return 0
+    return _row_tile(rows, _WORD_SUBLANE[w], MAX_ROW_TILE8 // (w // 8))
 
 
 def pallas_matrix_words_supported(shape, w: int) -> bool:
