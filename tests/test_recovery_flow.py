@@ -97,6 +97,43 @@ def test_osd_failure_recovery_flow():
     assert rebuilt == obj
 
 
+def test_eio_corruption_detected_and_rereconstructed():
+    """test-erasure-eio.sh analog: a bit-flipped shard fails its
+    crc32c gate (ECBackend's read path); the consumer treats it as an
+    erasure and reconstructs from the remaining shards."""
+    k, m_coding = 4, 2
+    reg = ErasureCodePluginRegistry.instance()
+    ec = reg.factory("jerasure", {"technique": "reed_sol_van",
+                                  "k": str(k), "m": str(m_coding)})
+    width = k * ec.get_chunk_size(k * 1024)
+    sinfo = StripeInfo(k, width)
+    rng = np.random.default_rng(5)
+    obj = rng.integers(0, 256, size=width * 4, dtype=np.uint8).tobytes()
+    shards = encode(sinfo, ec, obj)
+    hinfo = HashInfo(k + m_coding)
+    hinfo.append(0, shards)
+
+    # bit-flip one byte of shard 2 (silent media corruption)
+    bad = bytearray(shards[2])
+    bad[137] ^= 0x40
+    stored = dict(shards)
+    stored[2] = bytes(bad)
+
+    # read path: hash gate catches exactly the corrupt shard
+    failed = {s for s in stored
+              if ceph_crc32c(0xFFFFFFFF, stored[s])
+              != hinfo.get_chunk_hash(s)}
+    assert failed == {2}
+
+    # EIO -> treat as erasure, reconstruct, hash-verify, and the
+    # object reads back byte-exact
+    survivors = {s: stored[s] for s in stored if s not in failed}
+    plan = ec.minimum_to_decode(failed, set(survivors))
+    rec = decode(sinfo, ec, {s: survivors[s] for s in plan}, failed)[2]
+    assert rec == shards[2]
+    assert ceph_crc32c(0xFFFFFFFF, rec) == hinfo.get_chunk_hash(2)
+
+
 def test_mass_failure_degraded_but_readable():
     """Lose m OSDs at once: every pg stays readable (k survivors) and
     the bulk sweep agrees with per-pg scalar mapping."""
