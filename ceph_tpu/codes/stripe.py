@@ -241,6 +241,73 @@ def encode(sinfo: StripeInfo, ec, data: bytes,
     return out
 
 
+def _touched_range(sinfo: StripeInfo, shards: Dict[int, bytes],
+                   offset: int, length: int):
+    """Shared validation + stripe geometry for the logical-extent I/O
+    paths (read/overwrite): -> (start, n_stripes, c0, c1)."""
+    lengths = {len(v) for v in shards.values()}
+    if len(lengths) != 1:
+        raise ValueError("uneven shard buffers")
+    shard_len = lengths.pop()
+    if shard_len % sinfo.chunk_size:
+        raise ValueError("shard length not chunk-aligned")
+    obj_len = shard_len // sinfo.chunk_size * sinfo.stripe_width
+    if offset < 0 or length < 0 or offset + length > obj_len:
+        raise ValueError("extent outside the object")
+    start, span = sinfo.offset_len_to_stripe_bounds(offset, length)
+    n_stripes = span // sinfo.stripe_width
+    c0 = sinfo.logical_to_prev_chunk_offset(start)
+    c1 = c0 + n_stripes * sinfo.chunk_size
+    return start, n_stripes, c0, c1
+
+
+def _window_bytes(sinfo: StripeInfo, sub: Dict[int, bytes], k: int,
+                  n_stripes: int) -> bytes:
+    """Reassemble logical bytes of a touched range from per-chunk
+    slices (one reshape, the same layout math as encode/decode)."""
+    return np.stack([
+        np.frombuffer(sub[c], np.uint8).reshape(n_stripes,
+                                                sinfo.chunk_size)
+        for c in range(k)], axis=1).tobytes()
+
+
+def read(sinfo: StripeInfo, ec, shards: Dict[int, bytes],
+         offset: int, length: int) -> bytes:
+    """ECBackend reconstructing-read math (ECBackend::objects_read_async
+    → get_min_avail_to_read_shards, SURVEY.md §2.1): return the logical
+    bytes [offset, offset+length) of the object, decoding erased data
+    chunks for the touched stripes only.
+
+    ``shards`` holds whatever shard buffers survive (full-length each);
+    data shards present are used directly, missing ones are
+    reconstructed via minimum_to_decode over the touched chunk range —
+    one batched decode call for all touched stripes."""
+    k = ec.get_data_chunk_count()
+    mapping = _chunk_mapping(ec)
+    inv = {shard: chunk for chunk, shard in enumerate(mapping)}
+    start, n_stripes, c0, c1 = _touched_range(sinfo, shards, offset,
+                                              length)
+    if length == 0:
+        return b""
+
+    have_chunks = {inv[s] for s in shards}
+    want_data = set(range(k))
+    missing = want_data - have_chunks
+    sub: Dict[int, bytes] = {}
+    for chunk in want_data & have_chunks:
+        sub[chunk] = shards[mapping[chunk]][c0:c1]
+    if missing:
+        plan = ec.minimum_to_decode(missing, have_chunks)
+        reads = {mapping[c]: shards[mapping[c]][c0:c1] for c in plan}
+        rec = decode(sinfo, ec, reads, {mapping[c] for c in missing})
+        for chunk in missing:
+            sub[chunk] = rec[mapping[chunk]]
+
+    window = _window_bytes(sinfo, sub, k, n_stripes)
+    lo = offset - start
+    return window[lo:lo + length]
+
+
 def overwrite(sinfo: StripeInfo, ec, shards: Dict[int, bytes],
               offset: int, data: bytes) -> Dict[int, bytes]:
     """ECBackend read-modify-write math (ECTransaction::
@@ -256,28 +323,13 @@ def overwrite(sinfo: StripeInfo, ec, shards: Dict[int, bytes],
     shard extents."""
     k = ec.get_data_chunk_count()
     mapping = _chunk_mapping(ec)
-    lengths = {len(v) for v in shards.values()}
-    if len(lengths) != 1:
-        raise ValueError("uneven shard buffers")
-    shard_len = lengths.pop()
-    if shard_len % sinfo.chunk_size:
-        raise ValueError("shard length not chunk-aligned")
-    obj_len = shard_len // sinfo.chunk_size * sinfo.stripe_width
-    if offset + len(data) > obj_len:
-        raise ValueError("overwrite past object end")
-    start, length = sinfo.offset_len_to_stripe_bounds(offset, len(data))
-    n_stripes = length // sinfo.stripe_width
-    c0 = sinfo.logical_to_prev_chunk_offset(start)
-    c1 = c0 + n_stripes * sinfo.chunk_size
+    start, n_stripes, c0, c1 = _touched_range(sinfo, shards, offset,
+                                              len(data))
 
     # reassemble the old logical bytes of the touched range from the
-    # data shards (one reshape, same layout math as encode/decode),
-    # merge, re-encode through the validating encode()
-    old = np.stack([
-        np.frombuffer(shards[mapping[i]][c0:c1], np.uint8).reshape(
-            n_stripes, sinfo.chunk_size)
-        for i in range(k)], axis=1)
-    merged = bytearray(old.tobytes())
+    # data shards, merge, re-encode through the validating encode()
+    old = {i: shards[mapping[i]][c0:c1] for i in range(k)}
+    merged = bytearray(_window_bytes(sinfo, old, k, n_stripes))
     lo = offset - start
     merged[lo:lo + len(data)] = data
     sub = encode(sinfo, ec, bytes(merged))
