@@ -329,37 +329,123 @@ class OSDMap:
             # whole-pool sweep sharded over every visible device
             from ..parallel.sharded_crush import (default_crush_mesh,
                                                   sharded_bulk_do_rule)
-            out, cnt = sharded_bulk_do_rule(
+            raw_arr, _ = sharded_bulk_do_rule(
                 default_crush_mesh(), self._compiled_map(),
                 pool.crush_rule, pps, pool.size,
                 weight=list(self.osd_weight))
-            raws = [list(out[i, :cnt[i]]) for i in range(pool.pg_num)]
         elif engine == "bulk":
             from .bulk import bulk_do_rule
-            out, cnt = bulk_do_rule(
+            raw_arr, _ = bulk_do_rule(
                 self._compiled_map(), pool.crush_rule, pps, pool.size,
                 weight=list(self.osd_weight))
-            raws = [list(out[i, :cnt[i]]) for i in range(pool.pg_num)]
         else:
-            raws = [crush_do_rule(self.crush, pool.crush_rule, int(x),
+            raw_arr = np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE,
+                              np.int32)
+            for i, x in enumerate(pps):
+                r = crush_do_rule(self.crush, pool.crush_rule, int(x),
                                   pool.size, weight=list(self.osd_weight),
                                   choose_args=self._choose_args())
-                    for x in pps]
-        ups = []
-        up_primary = np.full(pool.pg_num, -1, np.int32)
-        for ps in range(pool.pg_num):
+                raw_arr[i, :len(r)] = r
+        raw_arr = np.asarray(raw_arr, dtype=np.int64)
+
+        # sparse layer: the few pgs with upmap entries take the scalar
+        # stages (and may widen the arrays past pool.size)
+        overrides: Dict[int, Tuple[List[int], int]] = {}
+        touched = {seed for pid, seed in self.pg_upmap if pid == pool_id}
+        touched |= {seed for pid, seed in self.pg_upmap_items
+                    if pid == pool_id}
+        for ps in range(pool.pg_num) if touched else ():
             pg_seed = pool.raw_pg_to_pg(ps)
-            raw = self._apply_upmap(pool, pg_seed, [int(o) for o in raws[ps]])
+            if pg_seed not in touched:
+                continue
+            row = [int(o) for o in raw_arr[ps]]
+            if pool.can_shift_osds():
+                # replicated raw results are variable-length; drop the
+                # array padding (EC keeps positional NONE holes)
+                row = [o for o in row if o != CRUSH_ITEM_NONE]
+            raw = self._apply_upmap(pool, pg_seed, row)
             u = self._raw_to_up_osds(pool, raw)
             u, prim = self._apply_primary_affinity(int(pps[ps]), pool, u)
-            ups.append(u)
+            overrides[ps] = (u, prim)
+
+        up, up_primary = self._bulk_up_from_raw(pool, raw_arr, pps)
+        width = max([up.shape[1]]
+                    + [len(u) for u, _ in overrides.values()])
+        if width > up.shape[1]:
+            wider = np.full((pool.pg_num, width), CRUSH_ITEM_NONE,
+                            np.int32)
+            wider[:, :up.shape[1]] = up
+            up = wider
+        for ps, (u, prim) in overrides.items():
+            up[ps] = u + [CRUSH_ITEM_NONE] * (width - len(u))
             up_primary[ps] = prim
-        # a full pg_upmap vector may exceed pool.size (the scalar path
-        # returns it verbatim); widen instead of truncating
-        width = max([pool.size] + [len(u) for u in ups])
-        up = np.full((pool.pg_num, width), CRUSH_ITEM_NONE, np.int32)
-        for ps, u in enumerate(ups):
-            up[ps, :len(u)] = u
+        return up, up_primary
+
+    def _bulk_up_from_raw(self, pool: PGPool, raw: np.ndarray,
+                          pps: np.ndarray
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized _raw_to_up_osds + _apply_primary_affinity over a
+        whole pool: (N, W) raw placements -> (up, up_primary).  Exact
+        per-row equivalence with the scalar stages is pinned by
+        tests/test_osdmap.py."""
+        n, w = raw.shape
+        alive = (np.asarray(self.osd_exists[:self.max_osd], dtype=bool)
+                 & np.asarray(self.osd_up[:self.max_osd], dtype=bool))
+        idx = np.clip(raw, 0, self.max_osd - 1)
+        valid = (raw != CRUSH_ITEM_NONE) & (raw >= 0) & \
+                (raw < self.max_osd) & alive[idx]
+        if pool.can_shift_osds():
+            # stable left-compaction of valid entries (replicated pools)
+            order = np.argsort(~valid, axis=1, kind="stable")
+            up = np.where(np.take_along_axis(valid, order, axis=1),
+                          np.take_along_axis(raw, order, axis=1),
+                          CRUSH_ITEM_NONE).astype(np.int32)
+        else:
+            up = np.where(valid, raw, CRUSH_ITEM_NONE).astype(np.int32)
+
+        uvalid = up != CRUSH_ITEM_NONE
+        any_valid = uvalid.any(axis=1)
+        first_valid = np.argmax(uvalid, axis=1)
+        up_primary = np.where(
+            any_valid,
+            np.take_along_axis(
+                up, first_valid[:, None], axis=1)[:, 0],
+            -1).astype(np.int32)
+
+        aff_vec = self.osd_primary_affinity
+        if aff_vec is None:
+            return up, up_primary
+        aff = np.asarray(aff_vec + [MAX_PRIMARY_AFFINITY]
+                         * (self.max_osd - len(aff_vec)), dtype=np.int64)
+        uidx = np.clip(up, 0, self.max_osd - 1)
+        a = np.where(uvalid, aff[uidx], MAX_PRIMARY_AFFINITY)
+        rows = uvalid & (a != MAX_PRIMARY_AFFINITY)
+        affected = rows.any(axis=1) & any_valid
+        if not affected.any():
+            return up, up_primary
+        # keep osd at position j iff a == MAX or hash(pps, o) >> 16 < a
+        draws = (crush_hash32_2(
+            np.broadcast_to(pps[:, None], up.shape).astype(np.uint32),
+            up.astype(np.uint32)).astype(np.int64) >> 16)
+        keep = uvalid & ((a >= MAX_PRIMARY_AFFINITY) | (draws < a))
+        any_keep = keep.any(axis=1)
+        first_keep = np.argmax(keep, axis=1)
+        # scalar semantics: first kept position wins; else the first
+        # valid position is the fallback
+        pos = np.where(any_keep, first_keep, first_valid)
+        sel = affected  # affected rows always have a valid fallback
+        new_primary = np.take_along_axis(up, pos[:, None], axis=1)[:, 0]
+        up_primary = np.where(sel, new_primary, up_primary).astype(np.int32)
+        if pool.can_shift_osds():
+            # rotate the chosen primary to the front (rows with pos>0)
+            rot = sel & (pos > 0)
+            if rot.any():
+                cols = np.arange(w)[None, :]
+                p = pos[:, None]
+                src = np.where(cols == 0, p,
+                               np.where(cols <= p, cols - 1, cols))
+                rotated = np.take_along_axis(up, src, axis=1)
+                up = np.where(rot[:, None], rotated, up)
         return up, up_primary
 
     def pg_to_up_acting_bulk(self, pool_id: int, engine: str = "bulk"

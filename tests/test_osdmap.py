@@ -269,6 +269,29 @@ def test_bulk_matches_scalar_pipeline(engine, erasure):
         assert actp[ps] == ap, f"ps={ps}"
 
 
+@pytest.mark.parametrize("erasure", [False, True])
+def test_vectorized_bulk_matches_scalar_randomized(erasure):
+    """The vectorized up-derivation/affinity/front-shift stages vs the
+    scalar oracle over a randomized cluster state (down + out osds,
+    mixed affinities), 512 pgs."""
+    rng = np.random.default_rng(4242)
+    m = make_map(n_hosts=7, devs=3, erasure=erasure, pg_num=512,
+                 rule_indep=erasure)
+    for o in rng.choice(m.max_osd, size=3, replace=False):
+        m.mark_down(int(o))
+        if rng.random() < 0.5:
+            m.mark_out(int(o))
+    for o in rng.choice(m.max_osd, size=6, replace=False):
+        m.set_primary_affinity(int(o), int(rng.integers(
+            0, MAX_PRIMARY_AFFINITY + 1)))
+    up, upp = m.pg_to_up_bulk(1, engine="host")
+    for ps in range(512):
+        u, p, _, _ = m.pg_to_up_acting_osds(1, ps)
+        padded = (u + [CRUSH_ITEM_NONE] * up.shape[1])[:up.shape[1]]
+        assert up[ps].tolist() == padded, f"ps={ps}"
+        assert upp[ps] == p, f"ps={ps}"
+
+
 def test_pg_counts_per_osd_sums():
     m = make_map(n_hosts=4, devs=2, pg_num=128)
     counts = m.pg_counts_per_osd(1, engine="host")
