@@ -354,10 +354,18 @@ class OSDMap:
         touched = {seed for pid, seed in self.pg_upmap if pid == pool_id}
         touched |= {seed for pid, seed in self.pg_upmap_items
                     if pid == pool_id}
-        for ps in range(pool.pg_num) if touched else ():
+        touched_ps: np.ndarray = np.empty(0, dtype=np.int64)
+        if touched:
+            # vectorized seed fold (raw_pg_to_pg over all ps), then
+            # select only the pgs that carry upmap entries
+            ps_all = np.arange(pool.pg_num, dtype=np.int64)
+            mask = pool.pg_num_mask
+            seeds = np.where((ps_all & mask) < pool.pg_num,
+                             ps_all & mask, ps_all & (mask >> 1))
+            touched_ps = ps_all[np.isin(seeds, list(touched))]
+        for ps in touched_ps:
+            ps = int(ps)
             pg_seed = pool.raw_pg_to_pg(ps)
-            if pg_seed not in touched:
-                continue
             row = [int(o) for o in raw_arr[ps]]
             if pool.can_shift_osds():
                 # replicated raw results are variable-length; drop the
