@@ -146,10 +146,11 @@ def _find_move(m: OSDMap, pool, up: np.ndarray, over: int,
     the most-underfull compatible osd: target not already in the pg,
     and in a failure domain distinct from the remaining replicas'."""
     order = np.argsort(dev)             # most underfull first
-    for ps in range(pool.pg_num):
+    # only pgs actually holding a replica on the overfull osd
+    candidates = np.nonzero((up == over).any(axis=1))[0]
+    for ps in candidates:
+        ps = int(ps)
         members = [int(o) for o in up[ps] if o != CRUSH_ITEM_NONE]
-        if over not in members:
-            continue
         key = (pool.pool_id, pool.raw_pg_to_pg(ps))
         if any(f == over or t == over
                for f, t in m.pg_upmap_items.get(key, [])):

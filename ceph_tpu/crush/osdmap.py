@@ -354,18 +354,11 @@ class OSDMap:
         touched = {seed for pid, seed in self.pg_upmap if pid == pool_id}
         touched |= {seed for pid, seed in self.pg_upmap_items
                     if pid == pool_id}
-        touched_ps: np.ndarray = np.empty(0, dtype=np.int64)
-        if touched:
-            # vectorized seed fold (raw_pg_to_pg over all ps), then
-            # select only the pgs that carry upmap entries
-            ps_all = np.arange(pool.pg_num, dtype=np.int64)
-            mask = pool.pg_num_mask
-            seeds = np.where((ps_all & mask) < pool.pg_num,
-                             ps_all & mask, ps_all & (mask >> 1))
-            touched_ps = ps_all[np.isin(seeds, list(touched))]
-        for ps in touched_ps:
-            ps = int(ps)
-            pg_seed = pool.raw_pg_to_pg(ps)
+        # for ps in [0, pg_num), raw_pg_to_pg(ps) == ps (the stable-mod
+        # fold only matters for raw seeds beyond pg_num), so pgs with
+        # upmap entries are exactly the entry seeds themselves
+        for ps in sorted(t for t in touched if 0 <= t < pool.pg_num):
+            pg_seed = ps
             row = [int(o) for o in raw_arr[ps]]
             if pool.can_shift_osds():
                 # replicated raw results are variable-length; drop the
