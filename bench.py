@@ -45,6 +45,22 @@ NORTH_STAR = ["--plugin", "jerasure",
 RECORDED_CPP_RS_GBPS = 2.62
 
 
+def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
+                host_gbps: float) -> dict:
+    """The one-line JSON shape for runs that could not measure the
+    device (both failure paths emit identical fields)."""
+    return {
+        "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
+        "value": None,
+        "unit": "GB/s",
+        "vs_baseline": None,
+        "baseline": cpp_src,
+        "baseline_gbps": round(cpp_gbps, 3),
+        "error": msg,
+        "host_gbps": round(host_gbps, 3),
+    }
+
+
 def _run(extra: list[str]) -> dict:
     bench = ErasureCodeBench()
     bench.setup(NORTH_STAR + extra)
@@ -91,17 +107,10 @@ def main() -> int:
     cpp_gbps, cpp_src = _cpp_baseline()
     if not _device_reachable():
         # emit an honest line rather than hanging the round's bench run
-        print(json.dumps({
-            "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
-            "value": None,
-            "unit": "GB/s",
-            "vs_baseline": None,
-            "baseline": cpp_src,
-            "baseline_gbps": round(cpp_gbps, 3),
-            "error": "jax device init unreachable (tunnel down); "
-                     "host numpy GB/s in host_gbps",
-            "host_gbps": round(host["gbps"], 3),
-        }))
+        print(json.dumps(_error_line(
+            "jax device init unreachable (tunnel down); "
+            "host numpy GB/s in host_gbps", cpp_gbps, cpp_src,
+            host["gbps"])))
         return 0
     # device throughput: chained encodes inside one dispatch; 1024
     # loops (= 64 GiB through the kernel) amortize the ~70 ms tunnel
@@ -110,17 +119,31 @@ def main() -> int:
     # resident uint32 SWAR layout, SURVEY §7 — same bytes, zero
     # repacking inside the chain).
     candidates = []
+    last_err = None
     for layout in ("packed", "bytes"):
         try:
             candidates.append(_run(["--device", "jax", "--batch", "64",
                                     "--loop", "1024",
                                     "--layout", layout]))
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 - recorded in error line
+            last_err = e
     # per-call (includes tunnel dispatch latency), for continuity
-    percall = _run(["--device", "jax", "--batch", "64",
-                    "--iterations", "100", "--resident"])
-    candidates.append(percall)
+    try:
+        percall = _run(["--device", "jax", "--batch", "64",
+                        "--iterations", "100", "--resident"])
+        candidates.append(percall)
+    except Exception as e:  # noqa: BLE001
+        last_err = e
+        percall = None
+    if not candidates:
+        # device probed reachable but every run failed (e.g. the
+        # tunnel wedged mid-measurement, or a kernel regression):
+        # surface the cause so the two are distinguishable
+        print(json.dumps(_error_line(
+            "device runs failed after reachability probe: "
+            f"{type(last_err).__name__}: {last_err}",
+            cpp_gbps, cpp_src, host["gbps"])))
+        return 0
     best = max(candidates, key=lambda r: r["gbps"])
     out = {
         "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
@@ -130,7 +153,7 @@ def main() -> int:
         "baseline": cpp_src,
         "baseline_gbps": round(cpp_gbps, 3),
         "layout": best.get("layout", "bytes"),
-        "percall_gbps": round(percall["gbps"], 3),
+        "percall_gbps": round(percall["gbps"], 3) if percall else None,
         "vs_numpy": round(best["gbps"] / host["gbps"], 3)
         if host["gbps"] > 0 else None,
     }
