@@ -145,7 +145,13 @@ def main() -> int:
             cpp_gbps, cpp_src, host["gbps"])))
         return 0
     best = max(candidates, key=lambda r: r["gbps"])
-    out = {
+    out = {}
+    if last_err is not None:
+        # some device runs failed (e.g. the chained --loop layouts)
+        # while others succeeded: flag it so a per-call-only number is
+        # never mistaken for a clean measurement
+        out["partial_error"] = f"{type(last_err).__name__}: {last_err}"
+    out |= {
         "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
         "value": round(best["gbps"], 3),
         "unit": "GB/s",
