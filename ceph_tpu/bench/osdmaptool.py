@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Dict
@@ -107,18 +108,27 @@ def test_map_pgs(m: OSDMap, pool_ids, engine: str) -> int:
 
 def upmap(m: OSDMap, pool_ids, out_path: str, deviation: float,
           max_entries: int, engine: str) -> int:
+    # one aggregate run over the pool set (OSDMap::calc_pg_upmaps
+    # only_pools semantics: combined per-osd counts, one target)
+    changes = calc_pg_upmaps(m, pool_ids, max_deviation=deviation,
+                             max_iterations=max_entries, engine=engine)
     lines = []
-    for pid in pool_ids:
-        changes = calc_pg_upmaps(m, pid, max_deviation=deviation,
-                                 max_iterations=max_entries,
-                                 engine=engine)
-        for (pool_id, seed), items in sorted(changes.items()):
-            flat = " ".join(f"{f} {t}" for f, t in items)
-            lines.append(
-                f"ceph osd pg-upmap-items {pool_id}.{seed} {flat}")
+    for (pool_id, seed), items in sorted(changes.items()):
+        flat = " ".join(f"{f} {t}" for f, t in items)
+        lines.append(
+            f"ceph osd pg-upmap-items {pool_id}.{seed} {flat}")
     out = open(out_path, "w") if out_path != "-" else sys.stdout
-    for ln in lines:
-        print(ln, file=out)
+    try:
+        for ln in lines:
+            print(ln, file=out)
+        out.flush()
+    except BrokenPipeError:
+        # stdout piped into head & co.: not an error.  Redirect the fd
+        # at devnull so the interpreter's exit-time flush can't raise
+        # again (the python docs' SIGPIPE pattern).
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
     if out is not sys.stdout:
         out.close()
         print(f"wrote {len(lines)} pg-upmap-items commands to {out_path}")

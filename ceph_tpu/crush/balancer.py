@@ -14,10 +14,10 @@ part upstream (pg_num × do_rule) — is ONE bulk evaluator call
 sparse up-sets on the host.  This is the "balancer-style bulk remap
 scoring" consumer the bulk path exists for.
 
-Simplifications vs upstream, by design: deviation is computed per pool
-(upstream aggregates over overlapping pools); candidate selection is
+Simplifications vs upstream, by design: candidate selection is
 first-fit over the overfull osd's pgs (upstream shuffles); no
-stddev-improvement early-exit heuristics.
+stddev-improvement early-exit heuristics.  Multi-pool aggregation
+(only_pools semantics) IS implemented — see calc_pg_upmaps.
 """
 
 from __future__ import annotations
@@ -89,52 +89,86 @@ def osd_crush_weights(cmap: CrushMap) -> np.ndarray:
     return w
 
 
-def calc_pg_upmaps(m: OSDMap, pool_id: int, max_deviation: float = 1.0,
+def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
                    max_iterations: int = 100, engine: str = "bulk"
                    ) -> Dict[Tuple[int, int], List[Tuple[int, int]]]:
     """Propose (and apply to ``m``) pg_upmap_items entries flattening
-    the pool's per-osd replica counts.  Returns the new entries.
+    per-osd replica counts.  Returns the new entries.
 
-    Done when every osd's count is within ``max_deviation`` of its
-    weight-proportional target (OSDMap::calc_pg_upmaps' loop condition)
-    or no further legal move exists."""
-    pool = m.pools[pool_id]
-    fd_type = rule_failure_domain(m.crush, pool.crush_rule)
+    ``pool_id``: a single pool id, a list of ids, or None = every pool
+    — multi-pool mode aggregates counts across pools against one
+    weight-proportional target, exactly OSDMap::calc_pg_upmaps'
+    only_pools behavior.  Done when every osd's count is within
+    ``max_deviation`` of its target or no further legal move exists."""
+    if pool_id is None:
+        pool_ids = sorted(m.pools)
+    elif isinstance(pool_id, int):
+        pool_ids = [pool_id]
+    else:
+        pool_ids = sorted(pool_id)
     weights = osd_crush_weights(m.crush)
     # out osds take no replicas and no target share
     for o in range(m.max_osd):
         if m.is_out(o) or not m.is_up(o):
             weights[o] = 0.0
-    if weights.sum() == 0:
+    if weights.sum() == 0 or not pool_ids:
         return {}
 
-    # osd -> failure-domain ancestor, precomputed once (the inner loop
-    # otherwise re-walks the hierarchy per (pg, candidate) pair)
+    # osd -> failure-domain ancestor per pool rule, precomputed once
+    # (the inner loop otherwise re-walks the hierarchy per candidate)
     parents = parent_map(m.crush)
-    fd_of = {o: ancestor_of_type(m.crush, o, fd_type, parents)
-             for o in range(m.max_osd)} if fd_type else {}
+    fd_types = {pid: rule_failure_domain(m.crush,
+                                         m.pools[pid].crush_rule)
+                for pid in pool_ids}
+    fd_of_by_type: Dict[int, Dict[int, Optional[int]]] = {}
+    for fdt in set(fd_types.values()):
+        if fdt:
+            fd_of_by_type[fdt] = {
+                o: ancestor_of_type(m.crush, o, fdt, parents)
+                for o in range(m.max_osd)}
 
     changes: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
-    for _ in range(max_iterations):
-        up, _ = m.pg_to_up_bulk(pool_id, engine=engine)
+
+    def pool_counts(up):
         flat = up.ravel()
         placed = flat[(flat != CRUSH_ITEM_NONE) & (flat >= 0)]
-        counts = np.bincount(placed, minlength=m.max_osd).astype(np.float64)
-        target = weights / weights.sum() * len(placed)
+        return np.bincount(placed, minlength=m.max_osd), len(placed)
+
+    # evaluate every pool once; per iteration only the pool whose
+    # upmap just changed is re-evaluated and re-counted (the
+    # evaluation is the expensive part)
+    ups = {pid: m.pg_to_up_bulk(pid, engine=engine)[0]
+           for pid in pool_ids}
+    counts_by_pool = {pid: pool_counts(up) for pid, up in ups.items()}
+    for _ in range(max_iterations):
+        counts = np.zeros(m.max_osd, dtype=np.float64)
+        n_placed = 0
+        for c, n in counts_by_pool.values():
+            counts += c
+            n_placed += n
+        target = weights / weights.sum() * n_placed
         dev = counts - target
         # ignore osds that can't take/give replicas
         dev[weights == 0] = 0.0
         if dev.max() <= max_deviation and dev.min() >= -max_deviation:
             break
         over = int(np.argmax(dev))
-        move = _find_move(m, pool, up, over, dev, fd_type, fd_of)
+        move = None
+        for pid in pool_ids:
+            fdt = fd_types[pid]
+            move = _find_move(m, m.pools[pid], ups[pid], over, dev, fdt,
+                              fd_of_by_type.get(fdt, {}))
+            if move is not None:
+                ps, under = move
+                key = (pid, m.pools[pid].raw_pg_to_pg(ps))
+                entry = m.pg_upmap_items.setdefault(key, [])
+                entry.append((over, under))
+                changes[key] = list(entry)
+                ups[pid] = m.pg_to_up_bulk(pid, engine=engine)[0]
+                counts_by_pool[pid] = pool_counts(ups[pid])
+                break
         if move is None:
             break
-        ps, under = move
-        key = (pool_id, pool.raw_pg_to_pg(ps))
-        entry = m.pg_upmap_items.setdefault(key, [])
-        entry.append((over, under))
-        changes[key] = list(entry)
     return changes
 
 

@@ -97,6 +97,31 @@ def test_balancer_idempotent_when_within_deviation():
     assert not again or len(again) <= 2
 
 
+def test_balancer_multi_pool_aggregate():
+    """Two pools on one cluster: aggregate balancing flattens the
+    COMBINED per-osd counts (upstream only_pools semantics), and every
+    pool's failure-domain constraint still holds."""
+    m = make_cluster(n_hosts=5, devs=2, pg_num=64)
+    m.pools[2] = PGPool(pool_id=2, pg_num=96, size=3)
+
+    def combined_spread():
+        c = (m.pg_counts_per_osd(1, engine="host").astype(float)
+             + m.pg_counts_per_osd(2, engine="host"))
+        return c.max() - c.min()
+
+    before = combined_spread()
+    changes = calc_pg_upmaps(m, None, max_deviation=1.0, engine="host")
+    after = combined_spread()
+    assert changes and after < before
+    assert {pid for pid, _ in changes} <= {1, 2}
+    for pid in (1, 2):
+        pool = m.pools[pid]
+        for ps in range(pool.pg_num):
+            up, _, _, _ = m.pg_to_up_acting_osds(pid, ps)
+            hosts = [o // 2 for o in up if o != CRUSH_ITEM_NONE]
+            assert len(hosts) == len(set(hosts))
+
+
 @pytest.mark.parametrize("engine", ["bulk"])
 def test_balancer_bulk_engine_matches_host_scoring(engine):
     m1 = make_cluster(pg_num=64)
