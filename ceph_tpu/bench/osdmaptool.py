@@ -122,16 +122,17 @@ def upmap(m: OSDMap, pool_ids, out_path: str, deviation: float,
         for ln in lines:
             print(ln, file=out)
         out.flush()
+        if out is not sys.stdout:
+            out.close()
+            print(f"wrote {len(lines)} pg-upmap-items commands "
+                  f"to {out_path}")
+            sys.stdout.flush()
     except BrokenPipeError:
         # stdout piped into head & co.: not an error.  Redirect the fd
         # at devnull so the interpreter's exit-time flush can't raise
         # again (the python docs' SIGPIPE pattern).
         devnull = os.open(os.devnull, os.O_WRONLY)
         os.dup2(devnull, sys.stdout.fileno())
-        return 0
-    if out is not sys.stdout:
-        out.close()
-        print(f"wrote {len(lines)} pg-upmap-items commands to {out_path}")
     return 0
 
 
