@@ -5,57 +5,60 @@
 # statistics block. Results go into BASELINE.md ("Measured on chip"
 # notes) and the round's BENCH notes.
 #
+# Every row's JSON line is ALSO appended, with timestamp + git sha, to
+# BENCH_ROWS_LAST_GOOD.jsonl — so a later tunnel outage still leaves
+# per-row numbers with provenance (VERDICT r03 Next#3).
+#
 # The axon tunnel wedges at times (see bench.py _device_reachable);
 # probe first:
 #   timeout 100 python -c "import jax; print(len(jax.devices()))"
 set -e
 
-echo "== north star encode, bytes layout (BASELINE row *) =="
-python -m ceph_tpu.bench.erasure_code_benchmark \
+LOG=BENCH_ROWS_LAST_GOOD.jsonl
+SHA=$(git rev-parse --short=12 HEAD 2>/dev/null || echo unknown)
+
+run_row() {
+    row="$1"; shift
+    echo "== $row =="
+    ts=$(date -u +%Y-%m-%dT%H:%M:%S+00:00)
+    if out=$("$@"); then
+        echo "$out"
+        printf '{"row": "%s", "timestamp": "%s", "git_sha": "%s", "result": %s}\n' \
+            "$row" "$ts" "$SHA" "$out" >> "$LOG"
+    else
+        # a failed row (tunnel wedge mid-run, OOM) must not silently
+        # truncate the sweep: record it and keep measuring
+        echo "ROW FAILED: $row" >&2
+        printf '{"row": "%s", "timestamp": "%s", "git_sha": "%s", "result": null}\n' \
+            "$row" "$ts" "$SHA" >> "$LOG"
+    fi
+}
+
+run_row "north star encode, bytes layout (BASELINE row *)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
     -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
     -s $((1<<20)) --batch 64 --loop 1024 --json
 
-echo "== north star encode, packed resident layout =="
-python -m ceph_tpu.bench.erasure_code_benchmark \
+run_row "north star encode, packed resident layout" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
     -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
     -s $((1<<20)) --batch 64 --loop 1024 --layout packed --json
 
-echo "== row 3: shec k=6 m=3 c=2 single-chunk decode =="
-python -m ceph_tpu.bench.erasure_code_benchmark \
+run_row "row 3: shec k=6 m=3 c=2 single-chunk decode" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
     -p shec -P k=6 -P m=3 -P c=2 -s $((6*131072)) \
     --workload decode -e 1 --batch 32 --loop 256 --json
 
-echo "== row 4: clay k=8 m=4 d=11 decode (1 erasure) =="
-python -m ceph_tpu.bench.erasure_code_benchmark \
+run_row "row 4: clay k=8 m=4 d=11 decode (1 erasure)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
     -p clay -P k=8 -P m=4 -P d=11 -s $((1<<20)) \
     --workload decode -e 1 --batch 16 --loop 64 --json
 
-echo "== row 4b: jerasure RS decode, packed layout =="
-python -m ceph_tpu.bench.erasure_code_benchmark \
+run_row "row 4b: jerasure RS decode, packed layout" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
     -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
     -s $((1<<20)) --workload decode -e 2 --batch 64 --loop 1024 \
     --layout packed --json
 
-echo "== row 5: 1M-PG bulk CRUSH sweep on device =="
-python - <<'EOF'
-import json, time
-import numpy as np
-from ceph_tpu.crush.builder import CrushBuilder
-from ceph_tpu.crush import bulk
-
-b = CrushBuilder()
-root = b.build_two_level(8, 4)
-b.add_simple_rule(0, root, "host", firstn=True)
-xs = np.arange(1_000_000)
-# one CompiledCrushMap reused so the jit cache persists, warmed at the
-# FULL sweep shape (jit specializes on shape) — the timed call then
-# measures throughput, not compilation
-cm = bulk.CompiledCrushMap(b.map)
-out, cnt = bulk.bulk_do_rule(cm, 0, xs, 3)
-t0 = time.perf_counter()
-out, cnt = bulk.bulk_do_rule(cm, 0, xs, 3)
-dt = time.perf_counter() - t0
-print(json.dumps({"metric": "bulk_crush_mappings_per_s",
-                  "value": round(len(xs) / dt), "unit": "mappings/s",
-                  "n": len(xs), "seconds": round(dt, 3)}))
-EOF
+run_row "row 5: 1M-PG bulk CRUSH sweep on device" \
+    python tools/bulk_crush_row.py

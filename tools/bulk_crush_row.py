@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""BASELINE.md row 5: 1M-PG bulk CRUSH sweep on the live device.
+
+Prints one JSON line; invoked by tools/bench_rows.sh (which records it
+in BENCH_ROWS_LAST_GOOD.jsonl with provenance).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.crush import bulk
+from ceph_tpu.crush.builder import CrushBuilder
+
+
+def main() -> int:
+    b = CrushBuilder()
+    root = b.build_two_level(8, 4)
+    b.add_simple_rule(0, root, "host", firstn=True)
+    xs = np.arange(1_000_000)
+    # one CompiledCrushMap reused so the jit cache persists, warmed at
+    # the FULL sweep shape (jit specializes on shape) — the timed call
+    # then measures throughput, not compilation
+    cm = bulk.CompiledCrushMap(b.map)
+    bulk.bulk_do_rule(cm, 0, xs, 3)
+    t0 = time.perf_counter()
+    bulk.bulk_do_rule(cm, 0, xs, 3)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "bulk_crush_mappings_per_s",
+                      "value": round(len(xs) / dt), "unit": "mappings/s",
+                      "n": len(xs), "seconds": round(dt, 3)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
