@@ -114,9 +114,13 @@ def _write_last_good(out: dict) -> None:
         datetime.timezone.utc).isoformat(timespec="seconds")
     rec["git_sha"] = _git_sha()
     try:
-        with open(LAST_GOOD, "w", encoding="utf-8") as f:
+        # atomic replace: a crash mid-write (the tunnel-wedge kill this
+        # file defends against) must not truncate the previous record
+        tmp = LAST_GOOD + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
             json.dump(rec, f, indent=1)
             f.write("\n")
+        os.replace(tmp, LAST_GOOD)
     except OSError:
         pass  # persistence is best-effort; the stdout line is the record
 

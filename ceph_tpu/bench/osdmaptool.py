@@ -52,8 +52,15 @@ def load_osdmap(path: str) -> OSDMap:
         from ..crush.compiler import compile_map
         cmap = compile_map(json.dumps(crush_spec))
     m = OSDMap(crush=cmap)
+    import dataclasses
+    known = {f.name for f in dataclasses.fields(PGPool)}
     for p in spec.get("pools", []):
-        pool = PGPool(**{k: v for k, v in p.items()})
+        unknown = set(p) - known
+        if unknown:
+            raise SystemExit(
+                f"osdmaptool: {path}: unknown pool field(s) "
+                f"{sorted(unknown)} (known: {sorted(known)})")
+        pool = PGPool(**p)
         m.pools[pool.pool_id] = pool
     for osd, w in spec.get("osd_weight", {}).items():
         m.osd_weight[int(osd)] = int(float(w) * IN_WEIGHT)
@@ -71,33 +78,77 @@ def load_osdmap(path: str) -> OSDMap:
 
 
 def dump_osdmap(m: OSDMap, pools) -> Dict:
+    """Inverse of load_osdmap: includes the override layers
+    (osd_weight/down/out, primary affinity, upmap items) so editing a
+    dumped map round-trips instead of silently dropping state."""
     from ..crush.compiler import decompile
-    return {
+    out = {
         "crush": json.loads(decompile(m.crush)),
         "pools": [{"pool_id": p.pool_id, "pg_num": p.pg_num,
                    "size": p.size, "crush_rule": p.crush_rule,
                    "erasure": p.erasure} for p in pools],
     }
+    reweights = {str(o): m.osd_weight[o] / IN_WEIGHT
+                 for o in range(m.max_osd)
+                 if m.osd_weight[o] not in (0, IN_WEIGHT)}
+    if reweights:
+        out["osd_weight"] = reweights
+    down = [o for o in range(m.max_osd) if not m.osd_up[o]]
+    if down:
+        out["osd_down"] = down
+    outs = [o for o in range(m.max_osd)
+            if m.osd_exists[o] and m.osd_weight[o] == 0]
+    if outs:
+        out["osd_out"] = outs
+    if m.osd_primary_affinity is not None:
+        aff = {str(o): m.osd_primary_affinity[o] / MAX_PRIMARY_AFFINITY
+               for o in range(m.max_osd)
+               if m.osd_primary_affinity[o] != MAX_PRIMARY_AFFINITY}
+        if aff:
+            out["primary_affinity"] = aff
+    if m.pg_upmap_items:
+        out["pg_upmap_items"] = {
+            f"{pid}.{seed}": [[f, t] for f, t in items]
+            for (pid, seed), items in sorted(m.pg_upmap_items.items())}
+    return out
 
 
 def test_map_pgs(m: OSDMap, pool_ids, engine: str) -> int:
     total = np.zeros(m.max_osd, dtype=np.int64)
+    first = np.zeros(m.max_osd, dtype=np.int64)
+    prim = np.zeros(m.max_osd, dtype=np.int64)
     n_pgs = 0
     begin = time.perf_counter()
     for pid in pool_ids:
         pool = m.pools[pid]
-        up, _, acting, _ = m.pg_to_up_acting_bulk(pid, engine=engine)
+        up, _, acting, actp = m.pg_to_up_acting_bulk(pid, engine=engine)
         n_pgs += pool.pg_num
         flat = acting.ravel()
         flat = flat[(flat != CRUSH_ITEM_NONE) & (flat >= 0)]
         total += np.bincount(flat, minlength=m.max_osd)
+        f0 = up[:, 0]
+        f0 = f0[(f0 != CRUSH_ITEM_NONE) & (f0 >= 0)]
+        first += np.bincount(f0, minlength=m.max_osd)
+        ap = actp[actp >= 0]
+        prim += np.bincount(ap, minlength=m.max_osd)
     elapsed = time.perf_counter() - begin
-    # osdmaptool --test-map-pgs output shape: per-osd counts + summary
+    # osdmaptool --test-map-pgs output shape: header, per-osd rows
+    # (count / first-in-up / primary / crush weight / reweight),
+    # summary.  The summary spans every existing IN osd (crush weight
+    # > 0 and not marked out) — an in-but-empty osd counts as 0, so
+    # min CAN be 0: that imbalance is exactly what the sweep surfaces
+    # (summarizing only nonzero counts masked it).
+    from ..crush.balancer import osd_crush_weights
+    crush_w = osd_crush_weights(m.crush)
+    in_mask = np.array([crush_w[o] > 0 and not m.is_out(o)
+                        for o in range(m.max_osd)])
+    print("#osd\tcount\tfirst\tprimary\tc wt\twt")
     for osd in range(m.max_osd):
-        print(f"osd.{osd}\t{total[osd]}")
-    in_osds = total[total > 0]
+        print(f"osd.{osd}\t{total[osd]}\t{first[osd]}\t{prim[osd]}"
+              f"\t{crush_w[osd] / 0x10000:.5g}"
+              f"\t{m.osd_weight[osd] / IN_WEIGHT:.5g}")
+    in_osds = total[in_mask] if in_mask.any() else total[total > 0]
     avg = in_osds.mean() if in_osds.size else 0.0
-    print(f"#osd\tcount\tfirst\tprimary\tc wt\twt")
     print(f" avg {avg:.2f} stddev {in_osds.std() if in_osds.size else 0:.2f}"
           f" min {in_osds.min() if in_osds.size else 0}"
           f" max {in_osds.max() if in_osds.size else 0}")
@@ -109,7 +160,8 @@ def test_map_pgs(m: OSDMap, pool_ids, engine: str) -> int:
 def upmap(m: OSDMap, pool_ids, out_path: str, deviation: float,
           max_entries: int, engine: str) -> int:
     # one aggregate run over the pool set (OSDMap::calc_pg_upmaps
-    # only_pools semantics: combined per-osd counts, one target)
+    # only_pools semantics: combined per-osd counts vs the sum of
+    # per-pool rule-subtree targets)
     changes = calc_pg_upmaps(m, pool_ids, max_deviation=deviation,
                              max_iterations=max_entries, engine=engine)
     lines = []

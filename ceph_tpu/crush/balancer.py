@@ -33,6 +33,7 @@ from .types import (
     CRUSH_RULE_CHOOSELEAF_INDEP,
     CRUSH_RULE_CHOOSE_FIRSTN,
     CRUSH_RULE_CHOOSE_INDEP,
+    CRUSH_RULE_TAKE,
     CrushMap,
 )
 
@@ -89,6 +90,31 @@ def osd_crush_weights(cmap: CrushMap) -> np.ndarray:
     return w
 
 
+def rule_weight_osd_map(cmap: CrushMap, ruleno: int) -> np.ndarray:
+    """Per-osd weight reachable from the rule's TAKE subtree(s) —
+    CrushWrapper::get_rule_weight_osd_map.  An osd outside every TAKE
+    subtree gets weight 0: the rule can never place a replica there,
+    so the balancer must neither count it toward the target nor pick
+    it as a move destination (on a multi-root or device-class map the
+    global tree weights would do exactly that)."""
+    w = np.zeros(cmap.max_devices, dtype=np.float64)
+    for op, arg1, _ in cmap.rules[ruleno].steps:
+        if op != CRUSH_RULE_TAKE:
+            continue
+        if arg1 >= 0:
+            w[arg1] += 1.0
+            continue
+        queue = [arg1]
+        while queue:
+            b = cmap.buckets[queue.pop()]
+            for item, iw in zip(b.items, b.item_weights):
+                if item >= 0:
+                    w[item] += iw / 0x10000
+                else:
+                    queue.append(item)
+    return w
+
+
 def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
                    max_iterations: int = 100, engine: str = "bulk"
                    ) -> Dict[Tuple[int, int], List[Tuple[int, int]]]:
@@ -96,9 +122,11 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
     per-osd replica counts.  Returns the new entries.
 
     ``pool_id``: a single pool id, a list of ids, or None = every pool
-    — multi-pool mode aggregates counts across pools against one
-    weight-proportional target, exactly OSDMap::calc_pg_upmaps'
-    only_pools behavior.  Done when every osd's count is within
+    — multi-pool mode aggregates combined per-osd counts against the
+    SUM of per-pool targets, each pool's target spread over the osds
+    its rule's TAKE subtree can reach (get_rule_weight_osd_map), which
+    is OSDMap::calc_pg_upmaps' only_pools behavior on multi-root /
+    device-class maps.  Done when every osd's count is within
     ``max_deviation`` of its target or no further legal move exists."""
     if pool_id is None:
         pool_ids = sorted(m.pools)
@@ -106,12 +134,20 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
         pool_ids = [pool_id]
     else:
         pool_ids = sorted(pool_id)
-    weights = osd_crush_weights(m.crush)
-    # out osds take no replicas and no target share
-    for o in range(m.max_osd):
-        if m.is_out(o) or not m.is_up(o):
-            weights[o] = 0.0
-    if weights.sum() == 0 or not pool_ids:
+    # per-pool reachable-osd weights from each pool rule's TAKE
+    # subtree (get_rule_weight_osd_map): on multi-root or device-class
+    # maps the global tree weights would target — and propose moves
+    # onto — osds the pool's rule can never reach (ADVICE r03)
+    rule_w: Dict[int, np.ndarray] = {}
+    for pid in pool_ids:
+        w = rule_weight_osd_map(m.crush, m.pools[pid].crush_rule)
+        # out/down osds take no replicas and no target share
+        for o in range(m.max_osd):
+            if m.is_out(o) or not m.is_up(o):
+                w[o] = 0.0
+        rule_w[pid] = w
+    pool_ids = [pid for pid in pool_ids if rule_w[pid].sum() > 0]
+    if not pool_ids:
         return {}
 
     # osd -> failure-domain ancestor per pool rule, precomputed once
@@ -140,24 +176,32 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
     ups = {pid: m.pg_to_up_bulk(pid, engine=engine)[0]
            for pid in pool_ids}
     counts_by_pool = {pid: pool_counts(up) for pid, up in ups.items()}
+    # each pool's replicas spread over ITS rule's reachable osds; the
+    # aggregate target is the sum of per-pool targets (the only_pools
+    # aggregation upstream does per-pool via pgs_by_osd + rule weight
+    # maps).  Loop-invariant: moves relocate replicas, never add or
+    # drop them.
+    target = np.zeros(m.max_osd, dtype=np.float64)
+    for pid in pool_ids:
+        target += (rule_w[pid] / rule_w[pid].sum()
+                   * counts_by_pool[pid][1])
     for _ in range(max_iterations):
         counts = np.zeros(m.max_osd, dtype=np.float64)
-        n_placed = 0
-        for c, n in counts_by_pool.values():
+        for c, _n in counts_by_pool.values():
             counts += c
-            n_placed += n
-        target = weights / weights.sum() * n_placed
         dev = counts - target
-        # ignore osds that can't take/give replicas
-        dev[weights == 0] = 0.0
+        # ignore osds no pool can reach
+        dev[target == 0] = 0.0
         if dev.max() <= max_deviation and dev.min() >= -max_deviation:
             break
         over = int(np.argmax(dev))
         move = None
         for pid in pool_ids:
+            if rule_w[pid][over] <= 0:
+                continue            # this pool's rule can't reach over
             fdt = fd_types[pid]
             move = _find_move(m, m.pools[pid], ups[pid], over, dev, fdt,
-                              fd_of_by_type.get(fdt, {}))
+                              fd_of_by_type.get(fdt, {}), rule_w[pid])
             if move is not None:
                 ps, under = move
                 key = (pid, m.pools[pid].raw_pg_to_pg(ps))
@@ -174,11 +218,12 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
 
 def _find_move(m: OSDMap, pool, up: np.ndarray, over: int,
                dev: np.ndarray, fd_type: int,
-               fd_of: Dict[int, Optional[int]]
-               ) -> Optional[Tuple[int, int]]:
+               fd_of: Dict[int, Optional[int]],
+               pool_w: np.ndarray) -> Optional[Tuple[int, int]]:
     """First pg on the overfull osd that can legally shed a replica to
-    the most-underfull compatible osd: target not already in the pg,
-    and in a failure domain distinct from the remaining replicas'."""
+    the most-underfull compatible osd: target reachable by this pool's
+    rule, not already in the pg, and in a failure domain distinct from
+    the remaining replicas'."""
     order = np.argsort(dev)             # most underfull first
     # only pgs actually holding a replica on the overfull osd
     candidates = np.nonzero((up == over).any(axis=1))[0]
@@ -195,6 +240,8 @@ def _find_move(m: OSDMap, pool, up: np.ndarray, over: int,
             under = int(under)
             if dev[under] >= -1e-9 or under == over:
                 break                   # nothing meaningfully underfull
+            if pool_w[under] <= 0:
+                continue                # outside this rule's subtree
             if under in members or not m.is_up(under) or m.is_out(under):
                 continue
             if fd_type and fd_of[under] in other_domains:

@@ -477,7 +477,11 @@ class OSDMap:
             if temp_primary >= 0:
                 acting_primary[ps] = temp_primary
             elif temp_pg is not None:
-                acting_primary[ps] = self._pick_primary(temp_pg)
+                picked = self._pick_primary(temp_pg)
+                if picked >= 0:
+                    acting_primary[ps] = picked
+                # an all-NONE temp list yields no primary: keep the
+                # up_primary fallback, matching pg_to_up_acting_osds
         return up, up_primary, acting, acting_primary
 
     # -- distribution scoring (balancer building block) ------------------

@@ -133,3 +133,55 @@ def test_balancer_bulk_engine_matches_host_scoring(engine):
     # identical maps + identical (bit-exact) engines -> identical moves
     assert c1 == c2
     assert m1.pg_upmap_items == m2.pg_upmap_items
+
+
+def make_two_root_cluster(pg_num=64, size=3):
+    """Two disjoint CRUSH roots (8 osds each); pool 1's rule takes only
+    root A.  The ADVICE r03 repro: balancing from GLOBAL tree weights
+    proposed moves onto root B, where the pool's rule can never place."""
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    roots = []
+    for r in range(2):
+        hosts = [b.add_bucket("straw2", "host",
+                              list(range((r * 4 + h) * 2,
+                                         (r * 4 + h) * 2 + 2)),
+                              name=f"r{r}host{h}")
+                 for h in range(4)]
+        roots.append(b.add_bucket("straw2", "root", hosts,
+                                  name=f"root{r}"))
+    for r in range(2):
+        b.add_rule(r, [step_take(roots[r]),
+                       step_chooseleaf_firstn(size, b.type_id("host")),
+                       step_emit()])
+    m = OSDMap(crush=b.map)
+    m.pools[1] = PGPool(pool_id=1, pg_num=pg_num, size=size,
+                        crush_rule=0)
+    return m
+
+
+def test_rule_weight_osd_map_stops_at_take_subtree():
+    from ceph_tpu.crush.balancer import rule_weight_osd_map
+    m = make_two_root_cluster()
+    w0 = rule_weight_osd_map(m.crush, 0)
+    w1 = rule_weight_osd_map(m.crush, 1)
+    assert (w0[:8] > 0).all() and (w0[8:] == 0).all()
+    assert (w1[:8] == 0).all() and (w1[8:] > 0).all()
+
+
+def test_balancer_stays_inside_rule_subtree():
+    """No proposed pg-upmap-items target may lie outside the pool
+    rule's TAKE subtree (upstream constrains candidates via
+    get_rule_weight_osd_map); previously root-B osds were proposed."""
+    m = make_two_root_cluster(pg_num=96)
+    changes = calc_pg_upmaps(m, 1, max_deviation=1.0, engine="host")
+    assert changes, "balancer should still balance within root A"
+    for (_, _), items in changes.items():
+        for frm, to in items:
+            assert frm < 8 and to < 8, \
+                f"move {frm}->{to} leaves the rule subtree"
+    # and the pool's placements remain exclusively on root A
+    for ps in range(m.pools[1].pg_num):
+        up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+        assert all(o < 8 for o in up if o != CRUSH_ITEM_NONE)

@@ -297,3 +297,20 @@ def test_pg_counts_per_osd_sums():
     counts = m.pg_counts_per_osd(1, engine="host")
     assert counts.sum() == 128 * 3
     assert (counts > 0).all()          # every osd gets work at this scale
+
+
+@pytest.mark.parametrize("engine", ["host", "bulk"])
+def test_bulk_all_none_pg_temp_matches_scalar(engine):
+    """A pg_temp entry whose every osd is nonexistent produces an
+    all-NONE temp list on an EC pool; the scalar path then keeps the
+    up_primary fallback — the bulk path must too (it used to return
+    acting_primary=-1; ADVICE r03)."""
+    m = make_map(n_hosts=5, devs=3, erasure=True, pg_num=16,
+                 rule_indep=True)
+    pool = m.pools[1]
+    m.pg_temp[(1, pool.raw_pg_to_pg(7))] = [99, 98]   # none exist
+    up, upp, acting, actp = m.pg_to_up_acting_bulk(1, engine=engine)
+    for ps in range(pool.pg_num):
+        u, p, a, ap = m.pg_to_up_acting_osds(1, ps)
+        assert actp[ps] == ap, f"ps={ps}: bulk {actp[ps]} scalar {ap}"
+        assert upp[ps] == p

@@ -88,3 +88,71 @@ def test_osdmaptool_requires_action(tmp_path):
     run("ceph_tpu.bench.osdmaptool", "--createsimple", "3", "-o", mapfn)
     r = run("ceph_tpu.bench.osdmaptool", mapfn)
     assert r.returncode == 2
+
+
+def test_osdmaptool_unknown_pool_field_clean_error(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "3", "-o", mapfn)
+    spec = json.load(open(mapfn))
+    spec["pools"][0]["bogus_field"] = 1
+    json.dump(spec, open(mapfn, "w"))
+    r = run("ceph_tpu.bench.osdmaptool", mapfn, "--test-map-pgs")
+    assert r.returncode != 0
+    assert "unknown pool field" in r.stderr and "bogus_field" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+def test_osdmaptool_dump_preserves_overrides(tmp_path):
+    """dump_osdmap must round-trip the override layers (reweight, down,
+    out, affinity, upmap items) so editing a dumped map doesn't lose
+    state."""
+    from ceph_tpu.bench.osdmaptool import dump_osdmap, load_osdmap
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "4",
+        "--pg-num", "32", "-o", mapfn)
+    m = load_osdmap(mapfn)
+    m.osd_weight[1] = 32768                 # reweight 0.5
+    m.mark_down(2)
+    m.mark_out(3)
+    m.set_primary_affinity(0, 0)
+    m.pg_upmap_items[(1, 5)] = [(0, 1)]
+    dumped = str(tmp_path / "dumped.json")
+    json.dump(dump_osdmap(m, list(m.pools.values())), open(dumped, "w"))
+    m2 = load_osdmap(dumped)
+    assert m2.osd_weight[1] == 32768
+    assert not m2.osd_up[2]
+    assert m2.osd_weight[3] == 0
+    assert m2.osd_primary_affinity[0] == 0
+    assert m2.pg_upmap_items[(1, 5)] == [(0, 1)]
+
+
+def test_osdmaptool_summary_counts_empty_in_osds(tmp_path):
+    """An in-but-empty osd belongs in the --test-map-pgs summary: min
+    must be able to reach 0 (the imbalance the sweep exists to show),
+    and the header precedes the per-osd rows."""
+    from ceph_tpu.bench.osdmaptool import dump_osdmap, load_osdmap
+    from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "4",
+        "--pg-num", "8", "-o", mapfn)
+    m = load_osdmap(mapfn)
+    pool = m.pools[1]
+    # drain osd 2 completely: for each pg holding it, upmap its
+    # replica to the one osd not already in the pg (4 osds, size 3)
+    for ps in range(pool.pg_num):
+        up, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+        members = [o for o in up if o != CRUSH_ITEM_NONE]
+        if 2 in members:
+            free = next(o for o in range(4) if o not in members)
+            m.pg_upmap_items[(1, pool.raw_pg_to_pg(ps))] = [(2, free)]
+    json.dump(dump_osdmap(m, [pool]), open(mapfn, "w"))
+    r = run("ceph_tpu.bench.osdmaptool", mapfn, "--test-map-pgs",
+            "--engine", "host")
+    assert r.returncode == 0, r.stderr
+    out = r.stdout.splitlines()
+    hdr = next(i for i, l in enumerate(out) if l.startswith("#osd"))
+    rows = next(i for i, l in enumerate(out) if l.startswith("osd.0"))
+    assert hdr < rows                       # header before rows
+    osd2 = next(l for l in out if l.startswith("osd.2"))
+    assert osd2.split("\t")[1] == "0", f"osd 2 not drained: {osd2}"
+    assert " min 0 " in r.stdout
