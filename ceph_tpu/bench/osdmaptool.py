@@ -60,6 +60,11 @@ def load_osdmap(path: str) -> OSDMap:
             raise SystemExit(
                 f"osdmaptool: {path}: unknown pool field(s) "
                 f"{sorted(unknown)} (known: {sorted(known)})")
+        missing = {"pool_id", "pg_num"} - set(p)
+        if missing:
+            raise SystemExit(
+                f"osdmaptool: {path}: pool entry missing required "
+                f"field(s) {sorted(missing)}")
         pool = PGPool(**p)
         m.pools[pool.pool_id] = pool
     for osd, w in spec.get("osd_weight", {}).items():
@@ -85,8 +90,10 @@ def dump_osdmap(m: OSDMap, pools) -> Dict:
     out = {
         "crush": json.loads(decompile(m.crush)),
         "pools": [{"pool_id": p.pool_id, "pg_num": p.pg_num,
-                   "size": p.size, "crush_rule": p.crush_rule,
-                   "erasure": p.erasure} for p in pools],
+                   "pgp_num": p.pgp_num, "size": p.size,
+                   "min_size": p.min_size, "crush_rule": p.crush_rule,
+                   "erasure": p.erasure, "hashpspool": p.hashpspool}
+                  for p in pools],
     }
     reweights = {str(o): m.osd_weight[o] / IN_WEIGHT
                  for o in range(m.max_osd)
@@ -129,7 +136,7 @@ def test_map_pgs(m: OSDMap, pool_ids, engine: str) -> int:
         f0 = up[:, 0]
         f0 = f0[(f0 != CRUSH_ITEM_NONE) & (f0 >= 0)]
         first += np.bincount(f0, minlength=m.max_osd)
-        ap = actp[actp >= 0]
+        ap = actp[(actp >= 0) & (actp < m.max_osd)]
         prim += np.bincount(ap, minlength=m.max_osd)
     elapsed = time.perf_counter() - begin
     # osdmaptool --test-map-pgs output shape: header, per-osd rows

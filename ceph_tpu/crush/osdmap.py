@@ -476,12 +476,9 @@ class OSDMap:
                     [CRUSH_ITEM_NONE] * (width - len(temp_pg))
             if temp_primary >= 0:
                 acting_primary[ps] = temp_primary
-            elif temp_pg is not None:
-                picked = self._pick_primary(temp_pg)
-                if picked >= 0:
-                    acting_primary[ps] = picked
-                # an all-NONE temp list yields no primary: keep the
-                # up_primary fallback, matching pg_to_up_acting_osds
+            # temp_primary < 0 means _get_temp_osds found no usable
+            # primary in the temp list (e.g. all-NONE): keep the
+            # up_primary fallback, matching pg_to_up_acting_osds
         return up, up_primary, acting, acting_primary
 
     # -- distribution scoring (balancer building block) ------------------

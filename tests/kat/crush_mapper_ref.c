@@ -1,0 +1,759 @@
+/* Independent known-answer reference for the FULL crush_do_rule path.
+ *
+ * A second, from-scratch C transcription of the upstream mapper
+ * semantics (src/crush/mapper.c): all five bucket choose algorithms
+ * (uniform perm / list / tree / straw / straw2 + choose_args),
+ * crush_choose_firstn with the complete retry ladder (collision,
+ * reject, local retries, local fallback perm search, descent retries),
+ * crush_choose_indep with positional r' strides and NONE holes,
+ * chooseleaf recursion (vary_r / stable), is_out reweight rejection,
+ * and the rule interpreter (TAKE / CHOOSE* / SET_* / EMIT).
+ *
+ * It shares NO code with ceph_tpu/crush/{hash,ln,mapper}.py — the
+ * rjenkins/crush_ln primitives are re-transcribed here (tables from
+ * long double, the Python uses 50-digit Decimal) — so a transposed
+ * line or off-by-one in either implementation makes the two disagree
+ * on randomized maps.  tests/test_crush_mapper_kat.py compiles this
+ * file at test time, streams randomized (map, rule, tunables, x)
+ * cases through it, and requires mapping-for-mapping agreement with
+ * BOTH the host oracle (mapper.py) and the fused device evaluator
+ * (bulk.py).
+ *
+ * stdin protocol (all integers, whitespace-separated):
+ *   T ctt clt clft cdo vr st      tunables (choose_total_tries,
+ *                                 local_tries, local_fallback_tries,
+ *                                 descend_once, vary_r, stable)
+ *   D maxdev                      max_devices
+ *   W n w0 .. w{n-1}              device reweights, 16.16 (weight_max=n)
+ *   B id alg type size            bucket header (id < 0)
+ *     I i0 .. i{size-1}           items
+ *     V w0 .. w{size-1}           item weights, 16.16
+ *     L s0 .. s{size-1}           cumulative sums   (alg==list only)
+ *     N nn n0 .. n{nn-1}          tree node weights (alg==tree only)
+ *     S s0 .. s{size-1}           straw factors     (alg==straw only)
+ *   A id npos [npos*size ws] nids [nids ids]   choose_arg for bucket
+ *   R ruleno nsteps  { P op arg1 arg2 } x nsteps
+ *   Q ruleno x result_max         query; prints "M x n out.."
+ *   E                             end
+ */
+
+#include <limits.h>
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* ---- rjenkins1 (src/crush/hash.c) ------------------------------- */
+
+#define MIX(a, b, c)            \
+  do {                          \
+    a = a - b;  a = a - c;  a = a ^ (c >> 13); \
+    b = b - c;  b = b - a;  b = b ^ (a << 8);  \
+    c = c - a;  c = c - b;  c = c ^ (b >> 13); \
+    a = a - b;  a = a - c;  a = a ^ (c >> 12); \
+    b = b - c;  b = b - a;  b = b ^ (a << 16); \
+    c = c - a;  c = c - b;  c = c ^ (b >> 5);  \
+    a = a - b;  a = a - c;  a = a ^ (c >> 3);  \
+    b = b - c;  b = b - a;  b = b ^ (a << 10); \
+    c = c - a;  c = c - b;  c = c ^ (b >> 15); \
+  } while (0)
+
+static const uint32_t SEED = 1315423911u;
+
+static uint32_t h2(uint32_t a, uint32_t b) {
+  uint32_t hash = SEED ^ a ^ b, x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(x, a, hash);
+  MIX(b, y, hash);
+  return hash;
+}
+
+static uint32_t h3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t hash = SEED ^ a ^ b ^ c, x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(c, x, hash);
+  MIX(y, a, hash);
+  MIX(b, x, hash);
+  MIX(y, c, hash);
+  return hash;
+}
+
+static uint32_t h4(uint32_t a, uint32_t b, uint32_t c, uint32_t d) {
+  uint32_t hash = SEED ^ a ^ b ^ c ^ d, x = 231232u, y = 1232u;
+  MIX(a, b, hash);
+  MIX(c, d, hash);
+  MIX(a, x, hash);
+  MIX(y, b, hash);
+  MIX(c, x, hash);
+  MIX(y, d, hash);
+  return hash;
+}
+
+/* ---- crush_ln (mapper.c, tables regenerated with long double) ---- */
+
+static int64_t RH[129], LH[129], LL[256];
+
+static void gen_tables(void) {
+  int i;
+  for (i = 0; i < 129; i++) {
+    int64_t index1 = 256 + 2 * i;
+    RH[i] = (int64_t)(((__int128)1 << 56) / index1);
+    if (((__int128)1 << 56) % index1) RH[i] += 1; /* ceil */
+    LH[i] = (int64_t)roundl(powl(2.0L, 48) *
+                            log2l((long double)index1 / 256.0L));
+  }
+  for (i = 0; i < 256; i++)
+    LL[i] = (int64_t)roundl(powl(2.0L, 48) *
+                            log2l(1.0L + (long double)i / 32768.0L));
+}
+
+static int64_t crush_ln(uint32_t xin) {
+  uint64_t x = (uint64_t)xin + 1, v = x;
+  int iexpon = 15;
+  int64_t rh, lh, ll, result;
+  uint64_t index1, index2;
+  while (v < 0x8000) {
+    v <<= 1;
+    iexpon -= 1;
+  }
+  index1 = v >> 8;
+  rh = RH[index1 - 128];
+  lh = LH[index1 - 128];
+  index2 = (uint64_t)(((unsigned __int128)v * (uint64_t)rh >> 48) & 0xff);
+  ll = LL[index2];
+  result = (int64_t)iexpon << 44;
+  result += (lh + ll) >> 4;
+  return result;
+}
+
+/* ---- map structures --------------------------------------------- */
+
+#define MAXB 128
+#define MAXI 64
+#define MAXRULE 8
+#define MAXSTEP 24
+#define MAXRES 64
+#define MAXDEV 1024
+#define ITEM_NONE 0x7fffffff
+#define ITEM_UNDEF (-0x7fffffff)
+
+#define ALG_UNIFORM 1
+#define ALG_LIST 2
+#define ALG_TREE 3
+#define ALG_STRAW 4
+#define ALG_STRAW2 5
+
+#define OP_NOOP 0
+#define OP_TAKE 1
+#define OP_CHOOSE_FIRSTN 2
+#define OP_CHOOSE_INDEP 3
+#define OP_EMIT 4
+#define OP_CHOOSELEAF_FIRSTN 6
+#define OP_CHOOSELEAF_INDEP 7
+#define OP_SET_CHOOSE_TRIES 8
+#define OP_SET_CHOOSELEAF_TRIES 9
+#define OP_SET_CHOOSE_LOCAL_TRIES 10
+#define OP_SET_CHOOSE_LOCAL_FALLBACK_TRIES 11
+#define OP_SET_CHOOSELEAF_VARY_R 12
+#define OP_SET_CHOOSELEAF_STABLE 13
+
+struct bucket {
+  int present;
+  int id, alg, type, size;
+  int items[MAXI];
+  int64_t weights[MAXI]; /* 16.16 */
+  int64_t sums[MAXI];    /* list cumulative */
+  int64_t straws[MAXI];  /* straw factors */
+  int num_nodes;
+  int64_t nodew[4 * MAXI];
+  /* choose_arg */
+  int npos;              /* 0 = no weight_set */
+  int64_t ws[8][MAXI];
+  int nids;              /* 0 = no ids override */
+  int64_t ids_ov[MAXI];
+  /* crush_work_bucket */
+  uint32_t perm_x, perm_n;
+  int perm[MAXI];
+};
+
+struct step { int op, arg1, arg2; };
+struct rule { int present, nsteps; struct step steps[MAXSTEP]; };
+
+static struct bucket buckets[MAXB]; /* index = -1-id */
+static struct rule rules[MAXRULE];
+static int max_devices;
+static int64_t devw[MAXDEV];
+static int weight_max;
+static int tun_total_tries, tun_local_tries, tun_local_fallback_tries;
+static int tun_descend_once, tun_vary_r, tun_stable;
+
+static struct bucket *bkt(int id) {
+  int slot = -1 - id;
+  if (slot < 0 || slot >= MAXB || !buckets[slot].present) return NULL;
+  return &buckets[slot];
+}
+
+/* ---- bucket choose ----------------------------------------------- */
+
+static int bucket_perm_choose(struct bucket *b, int x, int r) {
+  unsigned int pr = (unsigned int)r % (unsigned int)b->size;
+  unsigned int i, s;
+  if (b->perm_x != (uint32_t)x || b->perm_n == 0) {
+    b->perm_x = (uint32_t)x;
+    if (pr == 0) {
+      s = h3((uint32_t)x, (uint32_t)b->id, 0) % (unsigned int)b->size;
+      b->perm[0] = (int)s;
+      b->perm_n = 0xffff; /* magic: only the r=0 slot is filled */
+      goto out;
+    }
+    for (i = 0; i < (unsigned int)b->size; i++) b->perm[i] = (int)i;
+    b->perm_n = 0;
+  } else if (b->perm_n == 0xffff) {
+    /* clean up after the r=0 shortcut */
+    for (i = 1; i < (unsigned int)b->size; i++) b->perm[i] = (int)i;
+    b->perm[b->perm[0]] = 0;
+    b->perm_n = 1;
+  }
+  while (b->perm_n <= pr) {
+    unsigned int p = b->perm_n;
+    if (p < (unsigned int)b->size - 1) {
+      i = h3((uint32_t)x, (uint32_t)b->id, p) %
+          ((unsigned int)b->size - p);
+      if (i) {
+        int t = b->perm[p + i];
+        b->perm[p + i] = b->perm[p];
+        b->perm[p] = t;
+      }
+    }
+    b->perm_n++;
+  }
+  s = (unsigned int)b->perm[pr];
+out:
+  return b->items[s];
+}
+
+static int bucket_list_choose(struct bucket *b, int x, int r) {
+  int i;
+  for (i = b->size - 1; i >= 0; i--) {
+    uint64_t w = h4((uint32_t)x, (uint32_t)b->items[i], (uint32_t)r,
+                    (uint32_t)b->id);
+    w &= 0xffff;
+    w *= (uint64_t)b->sums[i];
+    w >>= 16;
+    if ((int64_t)w < b->weights[i]) return b->items[i];
+  }
+  return b->items[0];
+}
+
+static int tree_height(int n) {
+  int h = 0;
+  while ((n & 1) == 0) {
+    h++;
+    n >>= 1;
+  }
+  return h;
+}
+
+static int bucket_tree_choose(struct bucket *b, int x, int r) {
+  int n = b->num_nodes >> 1;
+  while (!(n & 1)) {
+    int l;
+    uint64_t w = (uint64_t)b->nodew[n];
+    uint64_t t = (uint64_t)h4((uint32_t)x, (uint32_t)n, (uint32_t)r,
+                              (uint32_t)b->id) * w;
+    t = t >> 32;
+    l = n - (1 << (tree_height(n) - 1));
+    if ((int64_t)t < b->nodew[l])
+      n = l;
+    else
+      n = n + (1 << (tree_height(n) - 1));
+  }
+  return b->items[n >> 1];
+}
+
+static int bucket_straw_choose(struct bucket *b, int x, int r) {
+  int i, high = 0;
+  uint64_t high_draw = 0, draw;
+  for (i = 0; i < b->size; i++) {
+    draw = (uint64_t)(h3((uint32_t)x, (uint32_t)b->items[i],
+                         (uint32_t)r) & 0xffff);
+    draw *= (uint64_t)b->straws[i];
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return b->items[high];
+}
+
+static int bucket_straw2_choose(struct bucket *b, int x, int r,
+                                int position) {
+  int i, high = 0;
+  int64_t high_draw = INT64_MIN, draw, ln, w;
+  int64_t *weights = b->weights;
+  uint32_t u;
+  if (b->npos > 0) {
+    int pos = position;
+    if (pos >= b->npos) pos = b->npos - 1;
+    weights = b->ws[pos];
+  }
+  for (i = 0; i < b->size; i++) {
+    w = weights[i];
+    if (w) {
+      int64_t id = (b->nids > 0) ? b->ids_ov[i] : (int64_t)b->items[i];
+      u = h3((uint32_t)x, (uint32_t)id, (uint32_t)r) & 0xffff;
+      ln = crush_ln(u) - 0x1000000000000ll;
+      draw = ln / w; /* div64_s64: C truncation toward zero */
+    } else {
+      draw = INT64_MIN;
+    }
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return b->items[high];
+}
+
+static int crush_bucket_choose(struct bucket *b, int x, int r,
+                               int position) {
+  switch (b->alg) {
+  case ALG_UNIFORM: return bucket_perm_choose(b, x, r);
+  case ALG_LIST:    return bucket_list_choose(b, x, r);
+  case ALG_TREE:    return bucket_tree_choose(b, x, r);
+  case ALG_STRAW:   return bucket_straw_choose(b, x, r);
+  case ALG_STRAW2:  return bucket_straw2_choose(b, x, r, position);
+  }
+  fprintf(stderr, "unknown alg %d\n", b->alg);
+  exit(3);
+}
+
+/* ---- is_out ------------------------------------------------------ */
+
+static int is_out(int64_t item, int x) {
+  int64_t w;
+  if (item >= weight_max) return 1;
+  w = devw[item];
+  if (w >= 0x10000) return 0;
+  if (w == 0) return 1;
+  if ((int64_t)(h2((uint32_t)x, (uint32_t)item) & 0xffff) < w) return 0;
+  return 1;
+}
+
+static int item_type(int item) {
+  struct bucket *b;
+  if (item >= 0) return 0;
+  b = bkt(item);
+  return b ? b->type : -1;
+}
+
+/* ---- crush_choose_firstn ----------------------------------------- */
+
+static int choose_firstn(struct bucket *bucket, int x, int numrep,
+                         int type, int *out, int outpos, int out_size,
+                         int tries, int recurse_tries, int local_retries,
+                         int local_fallback_retries, int recurse_to_leaf,
+                         int vary_r, int stable, int *out2,
+                         int parent_r) {
+  int rep;
+  unsigned int ftotal, flocal;
+  int retry_descent, retry_bucket, skip_rep;
+  struct bucket *in;
+  int r, i, item = 0, itemtype, collide, reject;
+  int count = out_size;
+
+  for (rep = stable ? 0 : outpos; rep < numrep && count > 0; rep++) {
+    ftotal = 0;
+    skip_rep = 0;
+    do {
+      retry_descent = 0;
+      in = bucket;
+      flocal = 0;
+      do {
+        collide = 0;
+        reject = 0;
+        retry_bucket = 0;
+        r = rep + parent_r;
+        r += ftotal; /* r' = r + f_total */
+
+        if (in->size == 0) {
+          reject = 1;
+          goto reject_label;
+        }
+        if (local_fallback_retries > 0 &&
+            flocal >= (unsigned int)(in->size >> 1) &&
+            flocal > (unsigned int)local_fallback_retries)
+          item = bucket_perm_choose(in, x, r);
+        else
+          item = crush_bucket_choose(in, x, r, outpos);
+        if (item >= max_devices) {
+          skip_rep = 1;
+          break;
+        }
+        itemtype = item_type(item);
+        if (itemtype != type) {
+          if (item >= 0 || bkt(item) == NULL) {
+            skip_rep = 1;
+            break;
+          }
+          in = bkt(item);
+          retry_bucket = 1;
+          continue;
+        }
+        for (i = 0; i < outpos; i++) {
+          if (out[i] == item) {
+            collide = 1;
+            break;
+          }
+        }
+        if (!collide && recurse_to_leaf) {
+          if (item < 0) {
+            int sub_r;
+            if (vary_r)
+              sub_r = r >> (vary_r - 1);
+            else
+              sub_r = 0;
+            if (choose_firstn(bkt(item), x, stable ? 1 : outpos + 1, 0,
+                              out2, outpos, count, recurse_tries, 0,
+                              local_retries, local_fallback_retries, 0,
+                              vary_r, stable, NULL,
+                              sub_r) <= outpos)
+              reject = 1;
+          } else {
+            out2[outpos] = item;
+          }
+        }
+        if (!reject && !collide) {
+          if (itemtype == 0) reject = is_out(item, x);
+        }
+reject_label:
+        if (reject || collide) {
+          ftotal++;
+          flocal++;
+          if (collide && flocal <= (unsigned int)local_retries)
+            retry_bucket = 1;
+          else if (local_fallback_retries > 0 &&
+                   flocal <= (unsigned int)(in->size +
+                                            local_fallback_retries))
+            retry_bucket = 1;
+          else if (ftotal < (unsigned int)tries)
+            retry_descent = 1;
+          else
+            skip_rep = 1;
+        }
+      } while (retry_bucket);
+    } while (retry_descent);
+
+    if (skip_rep) continue;
+    out[outpos] = item;
+    outpos++;
+    count--;
+  }
+  return outpos;
+}
+
+/* ---- crush_choose_indep ------------------------------------------ */
+
+static void choose_indep(struct bucket *bucket, int x, int left,
+                         int numrep, int type, int *out, int outpos,
+                         int tries, int recurse_tries,
+                         int recurse_to_leaf, int *out2, int parent_r) {
+  struct bucket *in;
+  int endpos = outpos + left;
+  int rep, r, i, item = 0, itemtype, collide;
+  unsigned int ftotal;
+
+  for (rep = outpos; rep < endpos; rep++) {
+    out[rep] = ITEM_UNDEF;
+    if (out2) out2[rep] = ITEM_UNDEF;
+  }
+
+  for (ftotal = 0; left > 0 && ftotal < (unsigned int)tries; ftotal++) {
+    for (rep = outpos; rep < endpos; rep++) {
+      if (out[rep] != ITEM_UNDEF) continue;
+      in = bucket;
+      for (;;) {
+        r = rep + parent_r;
+        /* positional stride so retries walk different perm slots */
+        if (in->alg == ALG_UNIFORM && in->size % numrep == 0)
+          r += (numrep + 1) * (int)ftotal;
+        else
+          r += numrep * (int)ftotal;
+
+        if (in->size == 0) {
+          out[rep] = ITEM_NONE;
+          if (out2) out2[rep] = ITEM_NONE;
+          left--;
+          break;
+        }
+        item = crush_bucket_choose(in, x, r, outpos);
+        if (item >= max_devices) {
+          out[rep] = ITEM_NONE;
+          if (out2) out2[rep] = ITEM_NONE;
+          left--;
+          break;
+        }
+        itemtype = item_type(item);
+        if (itemtype != type) {
+          if (item >= 0 || bkt(item) == NULL) {
+            out[rep] = ITEM_NONE;
+            if (out2) out2[rep] = ITEM_NONE;
+            left--;
+            break;
+          }
+          in = bkt(item);
+          continue;
+        }
+        collide = 0;
+        for (i = outpos; i < endpos; i++) {
+          if (out[i] == item) {
+            collide = 1;
+            break;
+          }
+        }
+        if (collide) break;
+        if (recurse_to_leaf) {
+          if (item < 0) {
+            choose_indep(bkt(item), x, 1, numrep, 0, out2, rep,
+                         recurse_tries, 0, 0, NULL, r);
+            if (out2[rep] == ITEM_NONE) break;
+          } else {
+            out2[rep] = item;
+          }
+        }
+        if (itemtype == 0 && is_out(item, x)) break;
+        out[rep] = item;
+        left--;
+        break;
+      }
+    }
+  }
+  for (rep = outpos; rep < endpos; rep++) {
+    if (out[rep] == ITEM_UNDEF) out[rep] = ITEM_NONE;
+    if (out2 && out2[rep] == ITEM_UNDEF) out2[rep] = ITEM_NONE;
+  }
+}
+
+/* ---- crush_do_rule ----------------------------------------------- */
+
+static int do_rule(int ruleno, int x, int *result, int result_max) {
+  struct rule *rule = &rules[ruleno];
+  int result_len = 0;
+  int w[MAXRES + 8], o[MAXRES + 8], c[MAXRES + 8];
+  int wsize = 0, osize, i, s;
+  int choose_tries = tun_total_tries + 1; /* "tries", not "retries" */
+  int choose_leaf_tries = 0;
+  int choose_local_retries = tun_local_tries;
+  int choose_local_fallback_retries = tun_local_fallback_tries;
+  int vary_r = tun_vary_r;
+  int stable = tun_stable;
+
+  for (s = 0; s < rule->nsteps; s++) {
+    struct step *st = &rule->steps[s];
+    int firstn = 0, recurse_to_leaf;
+    switch (st->op) {
+    case OP_TAKE:
+      if ((st->arg1 >= 0 && st->arg1 < max_devices) ||
+          bkt(st->arg1) != NULL) {
+        w[0] = st->arg1;
+        wsize = 1;
+      }
+      break;
+    case OP_SET_CHOOSE_TRIES:
+      if (st->arg1 > 0) choose_tries = st->arg1;
+      break;
+    case OP_SET_CHOOSELEAF_TRIES:
+      if (st->arg1 > 0) choose_leaf_tries = st->arg1;
+      break;
+    case OP_SET_CHOOSE_LOCAL_TRIES:
+      if (st->arg1 >= 0) choose_local_retries = st->arg1;
+      break;
+    case OP_SET_CHOOSE_LOCAL_FALLBACK_TRIES:
+      if (st->arg1 >= 0) choose_local_fallback_retries = st->arg1;
+      break;
+    case OP_SET_CHOOSELEAF_VARY_R:
+      if (st->arg1 >= 0) vary_r = st->arg1;
+      break;
+    case OP_SET_CHOOSELEAF_STABLE:
+      if (st->arg1 >= 0) stable = st->arg1;
+      break;
+    case OP_CHOOSELEAF_FIRSTN:
+    case OP_CHOOSE_FIRSTN:
+      firstn = 1;
+      /* fall through */
+    case OP_CHOOSELEAF_INDEP:
+    case OP_CHOOSE_INDEP: {
+      if (wsize == 0) break;
+      recurse_to_leaf = (st->op == OP_CHOOSELEAF_FIRSTN ||
+                         st->op == OP_CHOOSELEAF_INDEP);
+      osize = 0;
+      for (i = 0; i < wsize; i++) {
+        int numrep = st->arg1, out_size;
+        struct bucket *b;
+        if (numrep <= 0) {
+          numrep += result_max;
+          if (numrep <= 0) continue;
+        }
+        b = bkt(w[i]);
+        if (w[i] >= 0 || b == NULL) continue; /* probably ITEM_NONE */
+        if (firstn) {
+          int recurse_tries;
+          if (choose_leaf_tries)
+            recurse_tries = choose_leaf_tries;
+          else if (tun_descend_once)
+            recurse_tries = 1;
+          else
+            recurse_tries = choose_tries;
+          osize += choose_firstn(
+              b, x, numrep, st->arg2, o + osize, 0, result_max - osize,
+              choose_tries, recurse_tries, choose_local_retries,
+              choose_local_fallback_retries, recurse_to_leaf, vary_r,
+              stable, c + osize, 0);
+        } else {
+          out_size = (numrep < result_max - osize) ? numrep
+                                                   : result_max - osize;
+          choose_indep(b, x, out_size, numrep, st->arg2, o + osize, 0,
+                       choose_tries,
+                       choose_leaf_tries ? choose_leaf_tries : 1,
+                       recurse_to_leaf, c + osize, 0);
+          osize += out_size;
+        }
+      }
+      if (recurse_to_leaf)
+        memcpy(o, c, (size_t)osize * sizeof(int));
+      memcpy(w, o, (size_t)osize * sizeof(int));
+      wsize = osize;
+      break;
+    }
+    case OP_EMIT:
+      for (i = 0; i < wsize && result_len < result_max; i++)
+        result[result_len++] = w[i];
+      wsize = 0;
+      break;
+    case OP_NOOP:
+      break;
+    default:
+      fprintf(stderr, "unknown op %d\n", st->op);
+      exit(3);
+    }
+  }
+  return result_len;
+}
+
+/* ---- driver ------------------------------------------------------ */
+
+int main(void) {
+  char tag[4];
+  gen_tables();
+  memset(buckets, 0, sizeof(buckets));
+  memset(rules, 0, sizeof(rules));
+  for (;;) {
+    if (scanf("%3s", tag) != 1) break;
+    if (tag[0] == 'T') {
+      if (scanf("%d %d %d %d %d %d", &tun_total_tries, &tun_local_tries,
+                &tun_local_fallback_tries, &tun_descend_once,
+                &tun_vary_r, &tun_stable) != 6) return 2;
+    } else if (tag[0] == 'D') {
+      if (scanf("%d", &max_devices) != 1) return 2;
+    } else if (tag[0] == 'W') {
+      int n, i;
+      long long v;
+      if (scanf("%d", &n) != 1 || n > MAXDEV) return 2;
+      weight_max = n;
+      for (i = 0; i < n; i++) {
+        if (scanf("%lld", &v) != 1) return 2;
+        devw[i] = v;
+      }
+    } else if (tag[0] == 'B') {
+      int id, alg, type, size, i, slot;
+      struct bucket *b;
+      long long v;
+      if (scanf("%d %d %d %d", &id, &alg, &type, &size) != 4) return 2;
+      slot = -1 - id;
+      if (slot < 0 || slot >= MAXB || size > MAXI) return 2;
+      b = &buckets[slot];
+      memset(b, 0, sizeof(*b));
+      b->present = 1;
+      b->id = id;
+      b->alg = alg;
+      b->type = type;
+      b->size = size;
+      if (scanf("%3s", tag) != 1 || tag[0] != 'I') return 2;
+      for (i = 0; i < size; i++)
+        if (scanf("%d", &b->items[i]) != 1) return 2;
+      if (scanf("%3s", tag) != 1 || tag[0] != 'V') return 2;
+      for (i = 0; i < size; i++) {
+        if (scanf("%lld", &v) != 1) return 2;
+        b->weights[i] = v;
+      }
+      if (alg == ALG_LIST) {
+        if (scanf("%3s", tag) != 1 || tag[0] != 'L') return 2;
+        for (i = 0; i < size; i++) {
+          if (scanf("%lld", &v) != 1) return 2;
+          b->sums[i] = v;
+        }
+      } else if (alg == ALG_TREE) {
+        if (scanf("%3s %d", tag, &b->num_nodes) != 2 || tag[0] != 'N' ||
+            b->num_nodes > 4 * MAXI) return 2;
+        for (i = 0; i < b->num_nodes; i++) {
+          if (scanf("%lld", &v) != 1) return 2;
+          b->nodew[i] = v;
+        }
+      } else if (alg == ALG_STRAW) {
+        if (scanf("%3s", tag) != 1 || tag[0] != 'S') return 2;
+        for (i = 0; i < size; i++) {
+          if (scanf("%lld", &v) != 1) return 2;
+          b->straws[i] = v;
+        }
+      }
+    } else if (tag[0] == 'A') {
+      int id, npos, nids, i, p;
+      long long v;
+      struct bucket *b;
+      if (scanf("%d %d", &id, &npos) != 2) return 2;
+      b = bkt(id);
+      if (b == NULL || npos > 8) return 2;
+      b->npos = npos;
+      for (p = 0; p < npos; p++)
+        for (i = 0; i < b->size; i++) {
+          if (scanf("%lld", &v) != 1) return 2;
+          b->ws[p][i] = v;
+        }
+      if (scanf("%d", &nids) != 1 || nids > MAXI) return 2;
+      b->nids = nids;
+      for (i = 0; i < nids; i++) {
+        if (scanf("%lld", &v) != 1) return 2;
+        b->ids_ov[i] = v;
+      }
+    } else if (tag[0] == 'R') {
+      int ruleno, nsteps, s;
+      if (scanf("%d %d", &ruleno, &nsteps) != 2 || ruleno >= MAXRULE ||
+          nsteps > MAXSTEP) return 2;
+      rules[ruleno].present = 1;
+      rules[ruleno].nsteps = nsteps;
+      for (s = 0; s < nsteps; s++) {
+        if (scanf("%3s %d %d %d", tag, &rules[ruleno].steps[s].op,
+                  &rules[ruleno].steps[s].arg1,
+                  &rules[ruleno].steps[s].arg2) != 4 || tag[0] != 'P')
+          return 2;
+      }
+    } else if (tag[0] == 'Q') {
+      int ruleno, x, result_max, n, i;
+      int result[MAXRES + 8];
+      if (scanf("%d %d %d", &ruleno, &x, &result_max) != 3 ||
+          result_max > MAXRES || !rules[ruleno].present) return 2;
+      n = do_rule(ruleno, x, result, result_max);
+      printf("M %d %d", x, n);
+      for (i = 0; i < n; i++) printf(" %d", result[i]);
+      printf("\n");
+    } else if (tag[0] == 'E') {
+      break;
+    } else {
+      fprintf(stderr, "bad tag %s\n", tag);
+      return 2;
+    }
+  }
+  fflush(stdout);
+  return 0;
+}

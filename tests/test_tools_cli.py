@@ -156,3 +156,34 @@ def test_osdmaptool_summary_counts_empty_in_osds(tmp_path):
     osd2 = next(l for l in out if l.startswith("osd.2"))
     assert osd2.split("\t")[1] == "0", f"osd 2 not drained: {osd2}"
     assert " min 0 " in r.stdout
+
+
+def test_osdmaptool_dump_preserves_pool_shape_fields(tmp_path):
+    """pgp_num (mid-split), min_size, hashpspool survive a dump/load
+    round-trip — pgp_num feeds raw_pg_to_pps, so dropping it silently
+    remaps every pg."""
+    from ceph_tpu.bench.osdmaptool import dump_osdmap, load_osdmap
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "4",
+        "--pg-num", "32", "-o", mapfn)
+    m = load_osdmap(mapfn)
+    m.pools[1].pgp_num = 16
+    m.pools[1].min_size = 1
+    m.pools[1].hashpspool = False
+    dumped = str(tmp_path / "dumped.json")
+    json.dump(dump_osdmap(m, list(m.pools.values())), open(dumped, "w"))
+    m2 = load_osdmap(dumped)
+    assert m2.pools[1].pgp_num == 16
+    assert m2.pools[1].min_size == 1
+    assert m2.pools[1].hashpspool is False
+
+
+def test_osdmaptool_missing_pool_field_clean_error(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "3", "-o", mapfn)
+    spec = json.load(open(mapfn))
+    del spec["pools"][0]["pg_num"]
+    json.dump(spec, open(mapfn, "w"))
+    r = run("ceph_tpu.bench.osdmaptool", mapfn, "--test-map-pgs")
+    assert r.returncode != 0
+    assert "missing required" in r.stderr and "Traceback" not in r.stderr
