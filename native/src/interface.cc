@@ -94,10 +94,17 @@ int ErasureCode::decode(const std::set<int> &want_to_read,
     for (int c : want_to_read) (*decoded)[c] = chunks.at(c);
     return 0;
   }
-  ChunkMap work(chunks);
-  for (unsigned i = 0; i < get_chunk_count(); i++)
-    if (!work.count((int)i))
+  // ErasureCode.cc -> _decode fills *decoded for EVERY chunk: the
+  // available ones pass through, the missing ones get zero-filled
+  // buffers for decode_chunks to overwrite (a decode_chunks impl may
+  // only write the chunks it reconstructs)
+  for (unsigned i = 0; i < get_chunk_count(); i++) {
+    auto it = chunks.find((int)i);
+    if (it == chunks.end())
       (*decoded)[(int)i] = std::string(chunk_size, '\0');
+    else
+      (*decoded)[(int)i] = it->second;
+  }
   int r = decode_chunks(want_to_read, chunks, decoded);
   if (r) return r;
   for (auto it = decoded->begin(); it != decoded->end();)

@@ -35,7 +35,8 @@ def native_build():
 
 def test_native_builds(native_build):
     for target in ("libceph_tpu_ec.so", "libec_rs.so", "libec_tpu.so",
-                   "ceph_erasure_code_benchmark", "test_bridge_mt"):
+                   "ceph_erasure_code_benchmark", "ceph_erasure_code",
+                   "test_bridge_mt"):
         assert os.path.exists(os.path.join(native_build, target)), target
 
 
@@ -48,3 +49,108 @@ def test_native_ctest(native_build):
     env.pop("XLA_FLAGS", None)
     r = _run(["ctest", "--output-on-failure"], cwd=native_build, env=env)
     assert r.returncode == 0, f"ctest failed:\n{r.stdout}\n{r.stderr}"
+
+
+CORPUS = os.path.join(ROOT, "tests", "corpus")
+
+# corpus profiles the native AVX2 RS plugin supports (reed_sol_van,
+# w=8) — including the k=8,m=3 north-star shape
+RS_CORPUS = [
+    ("jerasure__k=4__m=2__technique=reed_sol_van",
+     ["-P", "k=4", "-P", "m=2"]),
+    ("jerasure__k=8__m=3__technique=reed_sol_van",
+     ["-P", "k=8", "-P", "m=3"]),
+]
+
+
+def _encode_cli(native_build, plugin, params, content, outdir, env=None):
+    exe = os.path.join(native_build, "ceph_erasure_code")
+    r = _run([exe, "encode", "--plugin", plugin, *params,
+              "--input", content, "--output-dir", str(outdir),
+              "-d", native_build], env=env)
+    assert r.returncode == 0, f"native encode failed:\n{r.stdout}\n{r.stderr}"
+
+
+@pytest.mark.parametrize("cdir,params", RS_CORPUS)
+def test_rs_chunks_byte_identical_to_python_corpus(native_build, tmp_path,
+                                                   cdir, params):
+    """Cross-implementation parity KAT (VERDICT r03 Next#2): the native
+    C++ AVX2 RS plugin (native/src/gf8.cc pshufb split tables) and the
+    Python/XLA jerasure path (ceph_tpu/matrices + region ops, pinned in
+    tests/corpus) are two independently-written GF(2^8) Reed-Solomon
+    implementations.  Their encoded chunks must agree byte-for-byte on
+    the committed corpus payloads — mutual validation that neither side
+    currently gets for free."""
+    src = os.path.join(CORPUS, cdir)
+    _encode_cli(native_build, "rs", params,
+                os.path.join(src, "content"), tmp_path)
+    k_m = sum(int(p.split("=")[1]) for p in params[1::2])
+    for i in range(k_m):
+        native_chunk = os.path.join(tmp_path, f"chunk.{i}")
+        corpus_chunk = os.path.join(src, str(i))
+        assert os.path.exists(native_chunk), f"chunk {i} not written"
+        with open(native_chunk, "rb") as f:
+            nb = f.read()
+        with open(corpus_chunk, "rb") as f:
+            cb = f.read()
+        assert nb == cb, (f"{cdir} chunk {i}: native C++ differs from "
+                          f"Python corpus ({len(nb)} vs {len(cb)} bytes)")
+
+
+def test_rs_decode_reconstructs_corpus_content(native_build, tmp_path):
+    """Native decode from a k-subset of the corpus chunks reproduces the
+    original payload — the C++ inverse path against Python-encoded
+    parity."""
+    src = os.path.join(CORPUS, "jerasure__k=8__m=3__technique=reed_sol_van")
+    import json as _json
+    with open(os.path.join(src, "manifest.json")) as f:
+        manifest = _json.load(f)
+    # stage survivors only (drop chunk 0: data, chunk 9: parity — the
+    # reconstruct-data and re-encode-parity branches both run)
+    for i in range(11):
+        if i in (0, 9):
+            continue
+        with open(os.path.join(src, str(i)), "rb") as f:
+            data = f.read()
+        with open(os.path.join(tmp_path, f"chunk.{i}"), "wb") as f:
+            f.write(data)
+    exe = os.path.join(native_build, "ceph_erasure_code")
+    out = os.path.join(tmp_path, "restored")
+    r = _run([exe, "decode", "--plugin", "rs", "-P", "k=8", "-P", "m=3",
+              "--input-dir", str(tmp_path), "--output", out,
+              "--size", str(manifest["size"]), "-d", native_build])
+    assert r.returncode == 0, f"native decode failed:\n{r.stdout}\n{r.stderr}"
+    with open(out, "rb") as f:
+        restored = f.read()
+    with open(os.path.join(src, "content"), "rb") as f:
+        content = f.read()
+    assert restored == content
+
+
+TPU_BRIDGE_CORPUS = [
+    ("jerasure__k=4__m=2__technique=reed_sol_van", 6,
+     ["-P", "backend=jerasure", "-P", "technique=reed_sol_van",
+      "-P", "k=4", "-P", "m=2"]),
+    ("shec__c=2__k=6__m=3", 9,
+     ["-P", "backend=shec", "-P", "k=6", "-P", "m=3", "-P", "c=2"]),
+]
+
+
+@pytest.mark.parametrize("cdir,nchunks,params", TPU_BRIDGE_CORPUS)
+def test_tpu_bridge_chunks_match_corpus(native_build, tmp_path, cdir,
+                                        nchunks, params):
+    """plugin=tpu (the embedded-CPython bridge) must produce the exact
+    corpus bytes through the dlopen ABI — pinning the bridge's buffer
+    handoff and padding discipline, not just its liveness."""
+    env = dict(os.environ, CEPH_TPU_JAX_PLATFORM="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    src = os.path.join(CORPUS, cdir)
+    _encode_cli(native_build, "tpu", params,
+                os.path.join(src, "content"), tmp_path, env=env)
+    for i in range(nchunks):
+        with open(os.path.join(tmp_path, f"chunk.{i}"), "rb") as f:
+            nb = f.read()
+        with open(os.path.join(src, str(i)), "rb") as f:
+            cb = f.read()
+        assert nb == cb, f"{cdir} chunk {i}: bridge differs from corpus"
