@@ -19,10 +19,11 @@ dense arrays and `crush_do_rule` becomes one fused jit program:
   exactly on the host reference mapper, so results are ALWAYS
   bit-identical to mapper.py / the C semantics, at any budget.
 
-Scope: straw2, legacy straw, list, and tree buckets fuse
-(alg-dispatched per bucket row; pure-straw2 maps compile no extra
-branches); uniform (stateful bucket_perm_choose) runs on the host
-mapper.
+Scope: all five bucket algorithms fuse (alg-dispatched per bucket row;
+pure-straw2 maps compile no extra branches).  Uniform buckets'
+bucket_perm_choose is stateful in C but pure per (x, r, bucket), so
+each lane recomputes its Fisher-Yates prefix (_uniform_choose); the
+indep r-stride through uniform buckets is applied per descent level.
 Jewel tunables (choose_local_* == 0).  Equivalence is pinned by
 tests/test_crush_bulk.py over randomized maps, rules and reweights.
 
@@ -51,6 +52,7 @@ from .types import (
     CRUSH_BUCKET_TREE,
     CRUSH_BUCKET_STRAW,
     CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_UNIFORM,
     CRUSH_ITEM_NONE,
     ChooseArg,
     CRUSH_RULE_CHOOSELEAF_FIRSTN,
@@ -89,11 +91,10 @@ class CompiledCrushMap:
                  ) -> None:
         for b in cmap.buckets.values():
             if b.alg not in (CRUSH_BUCKET_STRAW2, CRUSH_BUCKET_STRAW,
-                             CRUSH_BUCKET_LIST, CRUSH_BUCKET_TREE):
+                             CRUSH_BUCKET_LIST, CRUSH_BUCKET_TREE,
+                             CRUSH_BUCKET_UNIFORM):
                 raise ValueError(
-                    "bulk evaluator supports straw2/straw/list/tree maps "
-                    "(uniform perm state runs on the host "
-                    f"mapper); bucket alg {b.alg} is not fused")
+                    f"bucket alg {b.alg} is not fused; use engine=host")
         self.cmap = cmap
         self.choose_args = choose_args
         ids = sorted(cmap.buckets)          # negative ids
@@ -158,6 +159,11 @@ class CompiledCrushMap:
                         pos_weights[row, p, :b.size] = \
                             ws[min(p, len(ws) - 1)][:b.size]
         self.algs_present = sorted(set(int(a) for a in algs))
+        # uniform: the perm unroll length is the widest uniform bucket
+        # (Fisher-Yates steps are recomputed per lane; see _uniform)
+        self.max_uniform_size = max(
+            (cmap.buckets[b].size for b in ids
+             if cmap.buckets[b].alg == CRUSH_BUCKET_UNIFORM), default=0)
         max_neg = max((-bid for bid in ids), default=0)
         i2r = np.full(max_neg + 1, 0, np.int32)
         for bid, row in self.row_of_id.items():
@@ -173,9 +179,10 @@ class CompiledCrushMap:
         has_straw = CRUSH_BUCKET_STRAW in self.algs_present
         has_list = CRUSH_BUCKET_LIST in self.algs_present
         has_tree = CRUSH_BUCKET_TREE in self.algs_present
+        has_uniform = CRUSH_BUCKET_UNIFORM in self.algs_present
         self.straws = jnp.asarray(straws) if has_straw else None
-        self.bucket_ids = jnp.asarray(bids) if (has_list or has_tree) \
-            else None
+        self.bucket_ids = jnp.asarray(bids) \
+            if (has_list or has_tree or has_uniform) else None
         self.sum_weights = jnp.asarray(sum_weights) if has_list else None
         self.raw_weights = jnp.asarray(raw_weights) if has_list else None
         self.node_weights = jnp.asarray(node_weights) if has_tree else None
@@ -334,6 +341,42 @@ def _tree_choose(cm: CompiledCrushMap, row, x, r):
                                axis=-1)[..., 0]
 
 
+def _uniform_choose(cm: CompiledCrushMap, row, x, r):
+    """mapper.c -> bucket_perm_choose (uniform buckets), functional.
+
+    The C keeps per-bucket permutation *state* (perm_x / perm_n / the
+    r=0 magic slot), but the visible sequence is a pure function of
+    (x, r, bucket): pr = r % size, then the Fisher-Yates prefix
+    perm[0..pr] with swap offsets i_p = hash32_3(x, bucket_id, p) %
+    (size - p).  (The r=0 shortcut stores hash%size at slot 0 and the
+    cleanup swaps it with identity — exactly what step p=0 of the full
+    walk produces, so statefulness never shows.)  Each lane recomputes
+    the prefix; the unroll length is the widest uniform bucket in the
+    map."""
+    size = cm.sizes[row]                                   # (...,)
+    bid = cm.bucket_ids[row].astype(jnp.uint32)
+    pr = jnp.asarray(r, jnp.int64) % jnp.maximum(size, 1)  # C: unsigned r
+    S = max(cm.max_uniform_size, 1)
+    ar = jnp.arange(S)
+    perm = jnp.broadcast_to(ar, jnp.shape(row) + (S,)).astype(jnp.int32)
+    for p in range(S - 1):
+        # while perm_n <= pr: step at p runs when p <= pr (and the
+        # final-entry swap is skipped at p == size-1)
+        i = (crush_hash32_3(jnp.asarray(x, jnp.uint32), bid,
+                            jnp.uint32(p)).astype(jnp.int64)
+             % jnp.maximum(size - p, 1))
+        active = (p <= pr) & (p < size - 1)
+        idx = (p + i)[..., None]                           # (..., 1)
+        pv = perm[..., p][..., None]
+        iv = jnp.take_along_axis(perm, idx, axis=-1)
+        swapped = jnp.where(ar == p, iv, perm)
+        swapped = jnp.where(ar == idx, pv, swapped)
+        perm = jnp.where(active[..., None], swapped, perm)
+    s = jnp.take_along_axis(perm, pr[..., None].astype(jnp.int32),
+                            axis=-1)
+    return jnp.take_along_axis(cm.items[row], s, axis=-1)[..., 0]
+
+
 def _bucket_choose(cm: CompiledCrushMap, row, x, r, pos=0):
     """mapper.c -> crush_bucket_choose over the fused algorithms;
     branches compile only for algorithms present in the map (pure
@@ -353,32 +396,59 @@ def _bucket_choose(cm: CompiledCrushMap, row, x, r, pos=0):
         tc = _tree_choose(cm, row, x, r)
         res = tc if res is None else jnp.where(
             cm.algs[row] == CRUSH_BUCKET_TREE, tc, res)
+    if CRUSH_BUCKET_UNIFORM in cm.algs_present:
+        uc = _uniform_choose(cm, row, x, r)
+        res = uc if res is None else jnp.where(
+            cm.algs[row] == CRUSH_BUCKET_UNIFORM, uc, res)
     return res
 
 
 def _descend(cm: CompiledCrushMap, start_item, x, r, target_type,
-             steps: Optional[int] = None, pos=0):
+             steps: Optional[int] = None, pos=0,
+             indep_f=None, indep_numrep: Optional[int] = None,
+             return_last_r: bool = False):
     """Walk from start_item down to an item of target_type (mapper.c
     itemtype != type descent), statically unrolled ``steps`` times
     (regular hierarchies: exactly the level distance; else tree depth).
-    ``start_item``/``r``/``pos`` may be vectors (attempt batches)."""
+    ``start_item``/``r``/``pos`` may be vectors (attempt batches).
+
+    indep mode (``indep_f``/``indep_numrep`` set): crush_choose_indep
+    recomputes r at EVERY descent level from the CURRENT bucket —
+    r = base + (numrep+1)*ftotal when it is uniform with size % numrep
+    == 0, else base + numrep*ftotal — so the stride is applied here
+    per level, not baked into the r grid.  ``return_last_r`` also
+    returns the r used for each lane's final pick (the parent_r the C
+    passes to the chooseleaf recursion)."""
     r = jnp.asarray(r)
     if steps is None:
         steps = cm.max_depth + 1
     item = jnp.broadcast_to(jnp.asarray(start_item, jnp.int32), r.shape)
     done = jnp.zeros(r.shape, bool)
+    last_r = jnp.broadcast_to(r, r.shape)
     for _ in range(steps):
         is_bucket = item < 0
         row = jnp.where(is_bucket, cm.row(item), 0)
         itype = jnp.where(is_bucket, cm.types[row], 0)
         arrived = itype == target_type
-        picked = _bucket_choose(cm, row, x, r, pos)
-        nxt = jnp.where(done | arrived | ~is_bucket, item, picked)
+        if indep_f is not None:
+            stride = jnp.where(
+                (cm.algs[row] == CRUSH_BUCKET_UNIFORM)
+                & (cm.sizes[row] % indep_numrep == 0),
+                indep_numrep + 1, indep_numrep)
+            r_lvl = r + stride * indep_f
+        else:
+            r_lvl = r
+        picked = _bucket_choose(cm, row, x, r_lvl, pos)
+        picking = ~(done | arrived | ~is_bucket)
+        nxt = jnp.where(picking, picked, item)
+        last_r = jnp.where(picking, r_lvl, last_r)
         done = done | arrived | (~is_bucket)
         item = nxt
     is_bucket = item < 0
     row = jnp.where(is_bucket, cm.row(item), 0)
     itype = jnp.where(is_bucket, cm.types[row], 0)
+    if return_last_r:
+        return item, itype == target_type, last_r
     return item, itype == target_type
 
 
@@ -459,20 +529,29 @@ def _choose_firstn(cm, take, x, numrep, type_, recurse_to_leaf,
 def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
                   weight_vec, T, take_type):
     """mapper.c -> crush_choose_indep: candidate grid batched the same
-    way; rounds' accept logic sequential (r = rep + numrep*ftotal,
-    straw2-only stride)."""
-    rs = (jnp.arange(numrep, dtype=jnp.int64)[None, :]
-          + numrep * jnp.arange(T, dtype=jnp.int64)[:, None])  # (T, R)
-    # leaf recursion parent_r = r, inner rep index = rep: r2 = rep + r.
+    way; rounds' accept logic sequential.  The per-level r stride
+    (numrep, or numrep+1 through a uniform bucket with size % numrep
+    == 0) is applied inside _descend from the bucket actually being
+    picked from at each level."""
+    base = jnp.broadcast_to(jnp.arange(numrep, dtype=jnp.int64)[None, :],
+                            (T, numrep))                       # r = rep
+    fs = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int64)[:, None],
+                          (T, numrep))
+    # leaf recursion parent_r = the r of the pick that produced the
+    # domain item (stride included), inner rep = rep: r2 = rep + that r,
+    # inner ftotal = 0 (jewel: one leaf try) so no stride inside.
     # choose_args position: crush_choose_indep passes its own outpos
     # (= 0 here, one choose per take) to the domain pick, and rep to
     # the leaf recursion's bucket choose.
-    items, ok0 = _descend(cm, take, x, rs, type_,
-                          cm.descend_steps(take_type, type_), 0)
+    items, ok0, parent_r = _descend(cm, take, x, base, type_,
+                                    cm.descend_steps(take_type, type_),
+                                    0, indep_f=fs,
+                                    indep_numrep=numrep,
+                                    return_last_r=True)
     if recurse_to_leaf:
         leaves, lok = _descend(cm, items, x,
-                               rs + jnp.arange(numrep,
-                                               dtype=jnp.int64)[None, :],
+                               parent_r + jnp.arange(
+                                   numrep, dtype=jnp.int64)[None, :],
                                0, cm.descend_steps(type_, 0),
                                jnp.arange(numrep)[None, :])
         lout = _is_out(weight_vec, leaves, x)
@@ -516,14 +595,25 @@ def _chained_single(cm, takes, count, x, type_, recurse_to_leaf,
     downstream packing in mapper.c, so those lanes re-run on the host;
     indep leaves a NONE hole in place."""
     R = takes.shape[0]
-    # r = ftotal for both modes at numrep=1 (firstn: rep+parent_r+ftotal
-    # with rep=parent_r=0; indep: rep+numrep*ftotal with rep=0,numrep=1)
-    rs = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int64)[:, None], (T, R))
-    items, ok = _descend(cm, takes[None, :], x, rs, type_,
-                         cm.descend_steps(from_type, type_), 0)
+    # firstn at numrep=1: r = rep+parent_r+ftotal = ftotal.  indep at
+    # numrep=1: r = rep + stride*ftotal with the per-level uniform
+    # stride (size % 1 == 0 always, so uniform levels stride by 2) —
+    # applied inside _descend.
+    fs = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int64)[:, None], (T, R))
+    if firstn:
+        items, ok, parent_r = _descend(
+            cm, takes[None, :], x, fs, type_,
+            cm.descend_steps(from_type, type_), 0, return_last_r=True)
+    else:
+        items, ok, parent_r = _descend(
+            cm, takes[None, :], x, jnp.zeros_like(fs), type_,
+            cm.descend_steps(from_type, type_), 0, indep_f=fs,
+            indep_numrep=1, return_last_r=True)
     if recurse_to_leaf:
-        # jewel semantics: recursion rep 0, sub_r = r, one leaf try
-        leaves, lok = _descend(cm, items, x, rs, 0,
+        # jewel semantics: recursion rep 0, one leaf try; firstn:
+        # sub_r = r (vary_r=1); indep: parent_r = the final pick's r
+        leaf_r = fs if firstn else parent_r
+        leaves, lok = _descend(cm, items, x, leaf_r, 0,
                                cm.descend_steps(type_, 0), 0)
         lout = _is_out(weight_vec, leaves, x)
         ok = ok & lok & ~lout

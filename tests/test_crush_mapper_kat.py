@@ -117,8 +117,8 @@ def run_ref(exe: str, text: str):
 
 # -- randomized map generator --------------------------------------------
 
-ALGS_BULK = ["straw2", "straw2", "straw2", "straw", "list", "tree"]
-ALGS_ALL = ALGS_BULK + ["uniform"]
+ALGS = ["straw2", "straw2", "straw2", "straw", "list", "tree",
+        "uniform"]  # all five algorithms fuse since r04
 
 
 def gen_map(seed: int, bulk_ok: bool):
@@ -127,11 +127,12 @@ def gen_map(seed: int, bulk_ok: bool):
     bulk_ok=True keeps within the fused evaluator's envelope: jewel
     tunables, regular hierarchy, no SET_* steps, chained choose only
     with n=1.  bulk_ok=False exercises the rest: legacy tunables
-    (local retries + exhaustive fallback ladders), uniform buckets,
-    SET_* overrides, devices in TAKE, multi-emit rules.
+    (local retries + exhaustive fallback ladders), SET_* overrides,
+    devices in TAKE, multi-emit rules.  All five bucket algorithms
+    appear in both modes.
     """
     rng = np.random.default_rng(seed)
-    algs = ALGS_BULK if bulk_ok else ALGS_ALL
+    algs = ALGS
     if bulk_ok:
         tun = Tunables()
     else:
