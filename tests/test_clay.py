@@ -214,18 +214,15 @@ def test_get_chunk_size_isa_scalar():
         assert ec.get_chunk_size(sw) == -(-sw // alignment) * alignment // 4
 
 
-def test_scalar_mds_shec_constructs_and_roundtrips():
-    """scalar_mds=shec must construct (shec's 'technique' key means
-    single/multiple recovery and is NOT clay's MDS technique) and
-    round-trip; its chunk size follows the shec sub-code's alignment."""
-    ec = make(4, 2, 5, scalar_mds="shec")
-    sub = ErasureCodePluginRegistry.instance().factory(
-        "shec", {"k": "4", "m": "2", "c": "2", "w": "8"})
-    alignment = ec.get_sub_chunk_count() * 4 * sub.get_chunk_size(1)
-    assert ec.get_chunk_size(1) == alignment // 4
-    n = 6
-    data = roundtrip_data(ec, 3000)
-    encoded = ec.encode(set(range(n)), data)
-    avail = {i: encoded[i] for i in range(n) if i not in (0, 5)}
-    decoded = ec.decode({0, 5}, avail, len(encoded[0]))
-    assert decoded[0] == encoded[0] and decoded[5] == encoded[5]
+def test_scalar_mds_shec_rejected_loudly():
+    """scalar_mds=shec used to be silently aliased to jerasure matrices
+    (plausible-but-divergent parity bytes); it must now fail at init
+    (VERDICT r03 Next#5)."""
+    with pytest.raises(ValueError, match="shec"):
+        ErasureCodePluginRegistry.instance().factory(
+            "clay", {"k": "4", "m": "2", "d": "5",
+                     "scalar_mds": "shec"})
+    with pytest.raises(ValueError, match="jerasure or"):
+        ErasureCodePluginRegistry.instance().factory(
+            "clay", {"k": "4", "m": "2", "d": "5",
+                     "scalar_mds": "nonesuch"})
