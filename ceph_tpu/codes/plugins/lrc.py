@@ -94,6 +94,91 @@ class ErasureCodeLrc(ErasureCode):
         self._layer_specs = layers
         self.k = mapping.count("D")
         self.m = len(mapping) - self.k
+        self._parse_ruleset(profile, mapping,
+                            int(profile["l"]) if has_kml else None)
+
+    def _parse_ruleset(self, profile: ErasureCodeProfile, mapping: str,
+                       l: Optional[int]) -> None:
+        """ErasureCodeLrc.cc -> parse_ruleset / parse_kml's rule-step
+        derivation: store the crush-* placement keys and the rule-step
+        program create_rule() will emit.
+
+        - default: one chooseleaf indep 0 over crush-failure-domain;
+        - kml + crush-locality: choose indep <groups> over the locality
+          type, then chooseleaf indep <l+1> (each group's chunk count:
+          l data/global slots + 1 local parity) over the failure
+          domain — single-chunk repair reads then stay inside one
+          locality bucket;
+        - explicit "crush-steps" JSON [[op, type, n], ...] overrides.
+        """
+        self.rule_root = profile.get("crush-root", "default")
+        self.rule_device_class = profile.get("crush-device-class", "")
+        fd = profile.get("crush-failure-domain", "host")
+        self.rule_failure_domain = fd
+        self.rule_locality = profile.get("crush-locality", "")
+        if "crush-steps" in profile:
+            try:
+                raw = json.loads(profile["crush-steps"])
+                steps = [(str(op), str(t), int(n)) for op, t, n in raw]
+            except (ValueError, TypeError) as e:
+                raise ValueError(f"bad crush-steps: {e} "
+                                 f"(ERROR_LRC_RULESET_STEP)") from None
+            for op, _t, _n in steps:
+                if op not in ("choose", "chooseleaf"):
+                    raise ValueError(
+                        f"crush-steps op {op!r} must be choose or "
+                        f"chooseleaf (ERROR_LRC_RULESET_OP)")
+            self.rule_steps = steps
+        elif self.rule_locality and l is not None:
+            groups = len(mapping) // (l + 1)
+            self.rule_steps = [("choose", self.rule_locality, groups),
+                               ("chooseleaf", fd, l + 1)]
+        else:
+            self.rule_steps = [("chooseleaf", fd, 0)]
+
+    def create_rule(self, builder, rule_id: Optional[int] = None,
+                    name: str = "") -> int:
+        """ErasureCodeLrc.cc -> create_ruleset: emit the CRUSH rule the
+        stored crush-* keys describe into ``builder`` (CrushBuilder, the
+        CrushWrapper analog) and return its id.
+
+        Shape matches the reference: set_chooseleaf_tries 5,
+        set_choose_tries 100, take <crush-root[~class]>, then one
+        choose/chooseleaf INDEP step per rule step (erasure rules place
+        positionally), emit."""
+        from ...crush.types import (
+            RULE_TYPE_ERASURE,
+            step_choose_indep,
+            step_chooseleaf_indep,
+            step_emit,
+            step_set_choose_tries,
+            step_set_chooseleaf_tries,
+            step_take,
+        )
+        cmap = builder.map
+        by_name = {v: k for k, v in cmap.item_names.items()}
+        if self.rule_root not in by_name:
+            raise ValueError(f"crush-root {self.rule_root!r} is not a "
+                             f"bucket in this map (ERROR_LRC_RULESET_ROOT)")
+        root = by_name[self.rule_root]
+        if self.rule_device_class:
+            root = builder.get_shadow(root, self.rule_device_class)
+        steps = [step_set_chooseleaf_tries(5), step_set_choose_tries(100),
+                 step_take(root)]
+        for op, type_name, n in self.rule_steps:
+            try:
+                t = builder.type_id(type_name)
+            except KeyError:
+                raise ValueError(
+                    f"bucket type {type_name!r} not in map "
+                    f"(ERROR_LRC_RULESET_TYPE)") from None
+            steps.append(step_choose_indep(n, t) if op == "choose"
+                         else step_chooseleaf_indep(n, t))
+        steps.append(step_emit())
+        if rule_id is None:
+            rule_id = max(cmap.rules, default=-1) + 1
+        return builder.add_rule(rule_id, steps, name=name or "lrc",
+                                rule_type=RULE_TYPE_ERASURE)
 
     @staticmethod
     def _parse_layers_json(text: str) -> List[Tuple[str, str]]:
