@@ -106,3 +106,34 @@ def sharded_roundtrip_step(mesh: Mesh, data, m: int = 3):
 
     decoded = decode(data, parity)
     return decoded, parity
+
+
+def sharded_single_erasure_repair(mesh: Mesh, plugin: str, profile,
+                                  data):
+    """Sharded RECOVERY math: encode a stripe batch host-side, erase
+    chunk 0, compute the plugin's minimum read set (shec: < k chunks;
+    clay: d helpers with sub-chunk ranges), then run the plugin's
+    device decode with the batch sharded over EVERY mesh device (dp
+    over the flattened stripe x chunk axes; XLA partitions the batch,
+    no cross-chip traffic — recovery is per-stripe independent).
+
+    This is the multi-chip face of the decode path (the recovery math,
+    SURVEY §5) — the same surface the single-chip decode rows measure.
+
+    Returns (repaired (B, 1, C), n_read, n_chunks).
+    """
+    from ..codes.registry import ErasureCodePluginRegistry
+
+    ec = ErasureCodePluginRegistry.instance().factory(plugin, profile)
+    n = ec.get_chunk_count()
+    parity = np.asarray(ec.encode_chunks_batch(data))
+    allchunks = np.concatenate([data, parity], axis=1)
+    erased = (0,)
+    minimum = ec.minimum_to_decode({0}, set(range(1, n)))
+    positions = tuple(sorted(minimum))
+    surv = np.ascontiguousarray(allchunks[:, positions, :])
+    sharded = jax.device_put(
+        surv, NamedSharding(mesh, P(tuple(mesh.axis_names), None, None)))
+    out = jax.jit(
+        lambda s: ec.decode_chunks_jax(s, positions, erased))(sharded)
+    return np.asarray(out), len(positions), n
