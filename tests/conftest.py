@@ -27,3 +27,37 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# -- quick/slow split (VERDICT r03 Next#9) -------------------------------
+# Heavy XLA-compile sweeps are marked @pytest.mark.slow and SKIPPED by
+# default so the edit-test loop stays under ~5 minutes.  The FULL suite
+# (the round gate / judge run) is:
+#     CEPH_TPU_FULL=1 python -m pytest tests/ -q      (or --runslow,
+#     or tools/test_full.sh).  Skips are loud in the summary line.
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run @slow tests too (the full suite; see tools/test_full.sh)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy XLA-compile/randomized-sweep test; skipped by "
+        "default, run with --runslow or CEPH_TPU_FULL=1")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or os.environ.get("CEPH_TPU_FULL"):
+        return
+    skip = pytest.mark.skip(
+        reason="slow (full suite: --runslow / CEPH_TPU_FULL=1 / "
+               "tools/test_full.sh)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

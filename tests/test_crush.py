@@ -119,6 +119,7 @@ class TestDoRule:
                     moved += 1
         assert moved / checked < 0.05  # positional stability
 
+    @pytest.mark.slow
     def test_straw2_weight_proportionality(self):
         b = CrushBuilder()
         b.add_type(1, "root")

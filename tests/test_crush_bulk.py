@@ -74,6 +74,7 @@ def test_bulk_matches_host_regular(shape):
 @pytest.mark.parametrize("seed", [1, 2, 3])
 @pytest.mark.parametrize("shape", ["chooseleaf_firstn",
                                    "chooseleaf_indep"])
+@pytest.mark.slow
 def test_bulk_matches_host_irregular_weighted(shape, seed):
     """Irregular host sizes + random item weights."""
     b, root = build(5, 4, seed=seed)
@@ -81,6 +82,7 @@ def test_bulk_matches_host_irregular_weighted(shape, seed):
     pin(b, 0, 3, N=300)
 
 
+@pytest.mark.slow
 def test_bulk_matches_host_with_reweights(subtests=None):
     b, root = build(5, 4)
     b.add_rule(0, STEPS["chooseleaf_firstn"](root))
@@ -92,6 +94,7 @@ def test_bulk_matches_host_with_reweights(subtests=None):
     pin(b, 0, 3, weight=w)
     pin(b, 1, 4, weight=w)
 
+@pytest.mark.slow
 def test_bulk_matches_host_overload_few_hosts():
     """numrep > n_hosts: firstn comes up short, indep leaves holes —
     both must match the reference exactly."""
@@ -148,6 +151,7 @@ def test_bulk_gates_unsupported_shapes():
     assert host(b3.map, 0, 0, 3)
 
 
+@pytest.mark.slow
 def test_bulk_matches_host_dual_homed():
     """A dual-homed device passes the regularity gate; pin bulk == host
     there too (exercises the leaf-dedup vintage question both ways)."""
@@ -182,6 +186,7 @@ def _random_choose_args(b, rng, positions=3, with_ids=False):
 @pytest.mark.parametrize("shape", ["chooseleaf_firstn", "chooseleaf_indep",
                                    "choose_firstn_dev",
                                    "choose_indep_dev"])
+@pytest.mark.slow
 def test_bulk_matches_host_choose_args(shape, with_ids):
     """Balancer-style choose_args (per-position weight_set + ids
     override) on the bulk path, pinned bit-for-bit against the host
@@ -276,6 +281,7 @@ def test_bulk_chained_matches_host(shape):
 
 
 @pytest.mark.parametrize("seed", [7, 8])
+@pytest.mark.slow
 def test_bulk_chained_irregular_weighted(seed):
     b, root = build3level(3, 2, 3, seed=seed)
     b.add_rule(0, CHAIN_STEPS["indep_chain"](root))
@@ -284,6 +290,7 @@ def test_bulk_chained_irregular_weighted(seed):
     pin(b, 1, 3, N=250)
 
 
+@pytest.mark.slow
 def test_bulk_chained_with_reweights_and_choose_args():
     rng = np.random.default_rng(3)
     b, root = build3level(3, 2, 2)
@@ -301,6 +308,7 @@ def test_bulk_chained_with_reweights_and_choose_args():
         assert list(out[x]) == ref, (x, ref, list(out[x]))
 
 
+@pytest.mark.slow
 def test_bulk_chained_overload_holes():
     """numrep > racks: indep chains leave NONE holes where the domain
     pick failed — exactly like the host mapper."""
@@ -310,6 +318,7 @@ def test_bulk_chained_overload_holes():
 
 
 @pytest.mark.parametrize("alg", ["straw", "list", "tree"])
+@pytest.mark.slow
 def test_bulk_matches_host_legacy_algs(alg):
     """Legacy straw, list, and tree buckets run fused now (uniform
     stays host-gated); pinned bit-for-bit vs the host mapper."""
@@ -328,6 +337,7 @@ def test_bulk_matches_host_legacy_algs(alg):
     pin(b, 1, 3, N=300)
 
 
+@pytest.mark.slow
 def test_bulk_matches_host_mixed_algs():
     """straw2 root over straw and list hosts in one map."""
     b = CrushBuilder()
@@ -362,6 +372,7 @@ def test_bulk_uniform_now_fused():
     pin(b, 0, 2, N=64)
 
 
+@pytest.mark.slow
 def test_bulk_matches_host_tree_uneven_weights():
     """Tree walks with non-power-of-two sizes and skewed node weights,
     pinned bit-for-bit vs the host mapper."""
@@ -415,6 +426,7 @@ def build_uniform_mixed(seed=0, uniform_hosts=True, uniform_root=False):
 
 @pytest.mark.parametrize("rule", ["chooseleaf_firstn", "chooseleaf_indep"])
 @pytest.mark.parametrize("uniform_root", [False, True])
+@pytest.mark.slow
 def test_uniform_mixed_matches_host(rule, uniform_root):
     """A mixed straw2+uniform map compiles and matches the host mapper
     bit-for-bit (VERDICT r03 Next#4: this used to raise ValueError and
@@ -426,6 +438,7 @@ def test_uniform_mixed_matches_host(rule, uniform_root):
     pin(b, 0, 3)
 
 
+@pytest.mark.slow
 def test_uniform_only_map_matches_host():
     """Pure uniform hierarchy (every level perm-chooses), firstn and
     indep, with reweights driving rejection/retry paths."""
@@ -445,6 +458,7 @@ def test_uniform_only_map_matches_host():
     pin(b, 1, 3, weight=w)
 
 
+@pytest.mark.slow
 def test_uniform_indep_stride_divisible_size():
     """The stride special case: uniform buckets whose size % numrep == 0
     stride r by numrep+1 per ftotal — sizes chosen so the condition is
@@ -465,6 +479,7 @@ def test_uniform_indep_stride_divisible_size():
     pin(b, 0, 3, weight=w)
 
 
+@pytest.mark.slow
 def test_uniform_chained_choose_matches_host():
     """Chained choose (n rack -> chooseleaf 1 host) across uniform
     levels — the numrep=1 chained path where uniform ALWAYS strides by

@@ -242,7 +242,9 @@ def test_bulk_acting_keeps_oversized_pg_temp():
 
 # -- bulk path -----------------------------------------------------------
 
-@pytest.mark.parametrize("engine", ["host", "bulk", "sharded"])
+@pytest.mark.parametrize("engine", [
+    "host", "bulk",
+    pytest.param("sharded", marks=pytest.mark.slow)])
 @pytest.mark.parametrize("erasure", [False, True])
 def test_bulk_matches_scalar_pipeline(engine, erasure):
     m = make_map(n_hosts=5, devs=3, erasure=erasure, pg_num=48,

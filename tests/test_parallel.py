@@ -38,6 +38,7 @@ def test_sharded_roundtrip():
     assert parity.shape == (4, 3, 512)
 
 
+@pytest.mark.slow
 def test_sharded_bulk_crush_matches_host():
     """The x sweep sharded over an 8-device mesh is bit-identical to
     the host mapper (and to the single-chip bulk path)."""

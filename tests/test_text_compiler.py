@@ -129,6 +129,7 @@ choose_args 0 {
 """
 
 
+@pytest.mark.slow
 def test_compile_real_map_drives_evaluators():
     cmap = compile_text(REAL_MAP)
     assert cmap.max_devices == 6
@@ -301,6 +302,7 @@ def test_unsupported_rule_type_clear_error():
         compile_text(bad)
 
 
+@pytest.mark.slow
 def test_tester_forwards_choose_args_to_bulk():
     """test_rule(engine='bulk') must apply choose_args (and reject a
     mismatched pre-compiled map via bulk's guard)."""

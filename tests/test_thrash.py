@@ -14,6 +14,7 @@ The daemons are out of scope (SURVEY §7); this thrashes the math the
 daemons drive."""
 
 import numpy as np
+import pytest
 
 from ceph_tpu.codes.registry import ErasureCodePluginRegistry
 from ceph_tpu.codes.stripe import StripeInfo, decode, encode
@@ -44,6 +45,7 @@ def build():
     return m
 
 
+@pytest.mark.slow
 def test_thrash_placement_and_decodability():
     rng = np.random.default_rng(2024)
     osdmap = build()

@@ -88,6 +88,7 @@ def test_debug_mode_verifies_device_path(monkeypatch):
     ec.encode_chunks_batch(big)
 
 
+@pytest.mark.slow
 def test_debug_mode_verifies_bulk_lanes(monkeypatch):
     from ceph_tpu.crush import CrushBuilder, bulk as _  # noqa: F401
     from ceph_tpu.crush import bulk

@@ -66,8 +66,9 @@ def main() -> int:
     ap.add_argument("--crushtool", required=True)
     ap.add_argument("--num-x", type=int, default=512)
     a = ap.parse_args()
-    bad = 0
-    total = 0
+    bad = 0          # mappings that disagree
+    failed_runs = 0  # crushtool invocations that errored outright
+    total = 0        # mappings compared
     for name, cmap in build_cases():
         with tempfile.NamedTemporaryFile(suffix=".crush",
                                          delete=False) as f:
@@ -82,7 +83,7 @@ def main() -> int:
                     capture_output=True, text=True, timeout=120)
                 if r.returncode != 0:
                     print(f"{name}: crushtool failed: {r.stderr.strip()}")
-                    bad += 1
+                    failed_runs += 1
                     continue
                 for m in MAPPING_RE.finditer(r.stdout):
                     rn, x, osds = (int(m.group(1)), int(m.group(2)),
@@ -98,14 +99,16 @@ def main() -> int:
                                   f"ours {ours} crushtool {got}")
         finally:
             os.unlink(path)
-    print(f"crosswalk: {total - bad}/{total} mappings agree")
-    if total == 0:
+    print(f"crosswalk: {total - bad}/{total} mappings agree"
+          + (f"; {failed_runs} crushtool invocations failed"
+             if failed_runs else ""))
+    if total == 0 and not failed_runs:
         # format drift (or mappings on stderr) must read as FAILURE,
         # not as a vacuously passed verification
         print("no mappings parsed from crushtool output — "
               "--show-mappings format drift? inspect manually")
         return 1
-    return 1 if bad else 0
+    return 1 if (bad or failed_runs or total == 0) else 0
 
 
 if __name__ == "__main__":

@@ -62,21 +62,24 @@ for cand in "$REF/build/bin/ceph_erasure_code" \
 done
 if [ -n "$REF_BIN" ]; then
     echo "reference binary: $REF_BIN"
-    # NO pipe around this loop: fail=1 must survive into this shell
+    # NO pipe around this loop: fail=1 must survive into this shell.
+    # EVERY corpus profile is compared (clay/shec/lrc/isa included);
+    # plugin + parameters come from each manifest.json — directory
+    # names are not parseable (lrc layer values contain '__').
     {
-    for d in "$REPO"/tests/corpus/jerasure__*; do
+    for d in "$REPO"/tests/corpus/*/; do
+        d=${d%/}
         name=$(basename "$d")
-        # profile tokens are separated by DOUBLE underscores; values
-        # themselves contain single ones (reed_sol_van)
-        plugin=""
-        params=""
-        for tok in $(printf '%s' "$name" | sed 's/__/ /g'); do
-            if [ -z "$plugin" ]; then
-                plugin=$tok
-            else
-                params="$params -P $tok"
-            fi
-        done
+        [ -f "$d/manifest.json" ] || continue
+        plugin=$(python3 -c "import json,sys;print(json.load(open(sys.argv[1]))['plugin'])" "$d/manifest.json")
+        # "example" is this framework's didactic fixture plugin; the
+        # reference ships it only as a test double, not installed
+        [ "$plugin" = "example" ] && continue
+        params=$(python3 -c "
+import json, sys
+m = json.load(open(sys.argv[1]))
+print(' '.join(f'-P {k}={v}' for k, v in sorted(m['profile'].items())))
+" "$d/manifest.json")
         tmp=$(mktemp -d)
         if "$REF_BIN" encode --plugin "$plugin" $params \
                 --input "$d/content" --output-dir "$tmp" \
