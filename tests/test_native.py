@@ -154,3 +154,25 @@ def test_tpu_bridge_chunks_match_corpus(native_build, tmp_path, cdir,
         with open(os.path.join(src, str(i)), "rb") as f:
             cb = f.read()
         assert nb == cb, f"{cdir} chunk {i}: bridge differs from corpus"
+
+
+def test_tpu_bridge_pyroot_with_quotes_and_spaces(native_build, tmp_path):
+    """The embedded-interpreter bootstrap must survive a CEPH_TPU_PYROOT
+    containing quotes and spaces — values travel through the C API as
+    objects, never interpolated into python source."""
+    weird = tmp_path / "py root's \" \\ ~ dir"
+    weird.mkdir()
+    os.symlink(os.path.join(ROOT, "ceph_tpu"), weird / "ceph_tpu")
+    env = dict(os.environ, CEPH_TPU_JAX_PLATFORM="cpu",
+               CEPH_TPU_PYROOT=str(weird))
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    src = os.path.join(CORPUS, "jerasure__k=4__m=2__technique=reed_sol_van")
+    _encode_cli(native_build, "tpu",
+                ["-P", "backend=jerasure", "-P", "technique=reed_sol_van",
+                 "-P", "k=4", "-P", "m=2"],
+                os.path.join(src, "content"), tmp_path, env=env)
+    with open(os.path.join(tmp_path, "chunk.4"), "rb") as f:
+        nb = f.read()
+    with open(os.path.join(src, "4"), "rb") as f:
+        assert nb == f.read()
