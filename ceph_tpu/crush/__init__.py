@@ -22,6 +22,8 @@ mapper.c, CrushWrapper.{h,cc}, CrushTester.{h,cc}):
   pg/primary temp), scalar + whole-pool bulk paths.
 - ``balancer`` — OSDMap::calc_pg_upmaps analog: upmap balancing scored
   by the bulk evaluator.
+- ``incremental`` — OSDMap::Incremental / apply_incremental: the mon's
+  epoch-ordered map-mutation model; resume = epoch catch-up.
 """
 
 from .types import (  # noqa: F401

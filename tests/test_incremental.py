@@ -1,0 +1,161 @@
+"""OSDMap incremental machinery (crush/incremental.py) —
+OSDMap::Incremental / apply_incremental semantics: epoch monotonicity,
+XOR state bits, override-layer add/remove, and equivalence with direct
+map edits through the full placement pipeline."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import CrushBuilder, step_chooseleaf_firstn, step_emit, step_take
+from ceph_tpu.crush.incremental import (
+    CEPH_OSD_EXISTS,
+    CEPH_OSD_UP,
+    Incremental,
+    apply_incremental,
+    catch_up,
+    get_epoch,
+)
+from ceph_tpu.crush.osdmap import IN_WEIGHT, OSDMap, PGPool
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+
+
+def make_map(pg_num=32):
+    b = CrushBuilder()
+    root = b.build_two_level(4, 2)
+    b.add_rule(0, [step_take(root), step_chooseleaf_firstn(3, 1),
+                   step_emit()])
+    m = OSDMap(crush=b.map)
+    m.pools[1] = PGPool(pool_id=1, pg_num=pg_num, size=3)
+    return m
+
+
+def test_epoch_monotonic_and_gap_rejected():
+    m = make_map()
+    assert get_epoch(m) == 0
+    apply_incremental(m, Incremental(epoch=1))
+    assert m.epoch == 1
+    with pytest.raises(ValueError, match="does not follow"):
+        apply_incremental(m, Incremental(epoch=3))       # gap
+    with pytest.raises(ValueError, match="does not follow"):
+        apply_incremental(m, Incremental(epoch=1))       # replay
+    apply_incremental(m, Incremental(epoch=2))
+    assert m.epoch == 2
+
+
+def test_state_xor_down_and_purge():
+    """new_state XORs bits (upstream osd_state[osd] ^= s): xor UP marks
+    down; xor EXISTS|UP purges, clearing weight and affinity."""
+    m = make_map()
+    m.set_primary_affinity(3, 123)
+    apply_incremental(m, Incremental(epoch=1, new_state={3: CEPH_OSD_UP}))
+    assert not m.is_up(3) and m.exists(3)
+    # revive
+    apply_incremental(m, Incremental(epoch=2, new_state={3: CEPH_OSD_UP}))
+    assert m.is_up(3)
+    # purge: xor both bits away
+    apply_incremental(m, Incremental(
+        epoch=3, new_state={3: CEPH_OSD_EXISTS | CEPH_OSD_UP}))
+    assert not m.exists(3) and m.osd_weight[3] == 0
+    assert m.osd_primary_affinity[3] == 0x10000
+
+
+def test_override_layer_add_and_remove():
+    m = make_map()
+    seed = m.pools[1].raw_pg_to_pg(5)
+    apply_incremental(m, Incremental(
+        epoch=1,
+        new_pg_temp={(1, seed): [1, 2, 3]},
+        new_primary_temp={(1, seed): 2},
+        new_pg_upmap_items={(1, seed): [(0, 7)]}))
+    assert m.pg_temp[(1, seed)] == [1, 2, 3]
+    assert m.primary_temp[(1, seed)] == 2
+    assert m.pg_upmap_items[(1, seed)] == [(0, 7)]
+    # removal: empty temp vector, -1 primary, old_pg_upmap_items
+    apply_incremental(m, Incremental(
+        epoch=2,
+        new_pg_temp={(1, seed): []},
+        new_primary_temp={(1, seed): -1},
+        old_pg_upmap_items=[(1, seed)]))
+    assert (1, seed) not in m.pg_temp
+    assert (1, seed) not in m.primary_temp
+    assert (1, seed) not in m.pg_upmap_items
+
+
+def test_incrementals_equal_direct_edits_through_pipeline():
+    """A map advanced by incrementals must place every pg exactly like
+    a map edited directly — the full pg_to_up_acting pipeline is the
+    equality check (scalar + bulk engines)."""
+    m_inc = make_map(pg_num=48)
+    m_dir = make_map(pg_num=48)
+    seed = m_dir.pools[1].raw_pg_to_pg(7)
+
+    # direct edits
+    m_dir.mark_down(2)
+    m_dir.osd_weight[5] = IN_WEIGHT // 2
+    m_dir.set_primary_affinity(1, 77)
+    m_dir.pg_temp[(1, seed)] = [6, 7, 0]
+    m_dir.pools[2] = PGPool(pool_id=2, pg_num=16, size=2)
+
+    # the same state as epoch-ordered deltas
+    catch_up(m_inc, [
+        Incremental(epoch=1, new_state={2: CEPH_OSD_UP}),
+        Incremental(epoch=2, new_weight={5: IN_WEIGHT // 2}),
+        Incremental(epoch=3, new_primary_affinity={1: 77},
+                    new_pg_temp={(1, seed): [6, 7, 0]}),
+        Incremental(epoch=4,
+                    new_pools={2: PGPool(pool_id=2, pg_num=16, size=2)}),
+    ])
+    assert get_epoch(m_inc) == 4
+
+    for pid in (1, 2):
+        for ps in range(m_dir.pools[pid].pg_num):
+            assert (m_inc.pg_to_up_acting_osds(pid, ps)
+                    == m_dir.pg_to_up_acting_osds(pid, ps)), (pid, ps)
+    up_i, pr_i = m_inc.pg_to_up_bulk(1, engine="host")
+    up_d, pr_d = m_dir.pg_to_up_bulk(1, engine="host")
+    assert np.array_equal(up_i, up_d) and np.array_equal(pr_i, pr_d)
+
+
+def test_catch_up_sorts_and_skips_duplicates():
+    m = make_map()
+    incs = [Incremental(epoch=2, new_weight={0: 0}),
+            Incremental(epoch=1, new_state={1: CEPH_OSD_UP}),
+            Incremental(epoch=2, new_weight={0: 0})]
+    assert catch_up(m, incs) == 2
+    assert m.osd_weight[0] == 0 and not m.is_up(1)
+
+
+def test_new_crush_swaps_hierarchy_and_invalidates_cache():
+    m = make_map()
+    # warm the compiled-map cache on the old crush
+    m.pg_to_up_bulk(1, engine="bulk")
+    b2 = CrushBuilder()
+    root2 = b2.build_two_level(2, 4)
+    b2.add_rule(0, [step_take(root2), step_chooseleaf_firstn(3, 1),
+                    step_emit()])
+    apply_incremental(m, Incremental(epoch=1, new_crush=b2.map))
+    up, _ = m.pg_to_up_bulk(1, engine="bulk")
+    for ps in range(m.pools[1].pg_num):
+        u, _, _, _ = m.pg_to_up_acting_osds(1, ps)
+        padded = (u + [CRUSH_ITEM_NONE] * 3)[:3]
+        assert up[ps].tolist() == padded
+
+
+def test_new_max_osd_resizes_vectors():
+    m = make_map()
+    old = m.max_osd
+    apply_incremental(m, Incremental(epoch=1, new_max_osd=old + 4))
+    assert m.max_osd == old + 4
+    assert len(m.osd_exists) == old + 4
+    assert not m.osd_exists[old]        # new slots start absent
+    apply_incremental(m, Incremental(epoch=2, new_max_osd=old))
+    assert len(m.osd_weight) == old
+
+
+def test_new_state_zero_means_mark_down():
+    """Upstream legacy encoding: new_state[osd] == 0 is treated as
+    CEPH_OSD_UP (int s = new_state ? new_state : CEPH_OSD_UP) — a
+    transcribed real-cluster delta stream relies on it."""
+    m = make_map()
+    apply_incremental(m, Incremental(epoch=1, new_state={3: 0}))
+    assert not m.is_up(3) and m.exists(3)
