@@ -146,25 +146,8 @@ class ErasureCodeLrc(ErasureCode):
         set_choose_tries 100, take <crush-root[~class]>, then one
         choose/chooseleaf INDEP step per rule step (erasure rules place
         positionally), emit."""
-        from ...crush.types import (
-            RULE_TYPE_ERASURE,
-            step_choose_indep,
-            step_chooseleaf_indep,
-            step_emit,
-            step_set_choose_tries,
-            step_set_chooseleaf_tries,
-            step_take,
-        )
-        cmap = builder.map
-        by_name = {v: k for k, v in cmap.item_names.items()}
-        if self.rule_root not in by_name:
-            raise ValueError(f"crush-root {self.rule_root!r} is not a "
-                             f"bucket in this map (ERROR_LRC_RULESET_ROOT)")
-        root = by_name[self.rule_root]
-        if self.rule_device_class:
-            root = builder.get_shadow(root, self.rule_device_class)
-        steps = [step_set_chooseleaf_tries(5), step_set_choose_tries(100),
-                 step_take(root)]
+        from ...crush.types import step_choose_indep, step_chooseleaf_indep
+        choose_steps = []
         for op, type_name, n in self.rule_steps:
             try:
                 t = builder.type_id(type_name)
@@ -172,13 +155,11 @@ class ErasureCodeLrc(ErasureCode):
                 raise ValueError(
                     f"bucket type {type_name!r} not in map "
                     f"(ERROR_LRC_RULESET_TYPE)") from None
-            steps.append(step_choose_indep(n, t) if op == "choose"
-                         else step_chooseleaf_indep(n, t))
-        steps.append(step_emit())
-        if rule_id is None:
-            rule_id = max(cmap.rules, default=-1) + 1
-        return builder.add_rule(rule_id, steps, name=name or "lrc",
-                                rule_type=RULE_TYPE_ERASURE)
+            choose_steps.append(step_choose_indep(n, t) if op == "choose"
+                                else step_chooseleaf_indep(n, t))
+        return builder.add_erasure_rule(
+            self.rule_root, choose_steps, rule_id=rule_id,
+            name=name or "lrc", device_class=self.rule_device_class)
 
     @staticmethod
     def _parse_layers_json(text: str) -> List[Tuple[str, str]]:

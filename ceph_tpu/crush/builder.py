@@ -122,6 +122,19 @@ class CrushBuilder:
         self._next_bucket = -1
         self._type_ids: Dict[str, int] = {"osd": 0}
 
+    @classmethod
+    def from_map(cls, cmap: CrushMap) -> "CrushBuilder":
+        """Wrap an EXISTING map for further edits (CrushWrapper is
+        always an owner-wrapper; maps loaded from text/JSON/binary or
+        carried by an OSDMap re-enter the edit API this way)."""
+        b = cls.__new__(cls)
+        b.map = cmap
+        b._next_bucket = min(cmap.buckets, default=0) - 1
+        b._type_ids = {"osd": 0}  # implicit device type, as in __init__
+        b._type_ids.update(
+            {name: tid for tid, name in cmap.type_names.items()})
+        return b
+
     # -- types / names ------------------------------------------------------
 
     def add_type(self, type_id: int, name: str) -> None:
@@ -180,6 +193,40 @@ class CrushBuilder:
         self.map.rules[rule_id] = Rule(rule_id=rule_id, type=rule_type,
                                        steps=list(steps), name=name)
         return rule_id
+
+    def resolve_bucket(self, name: str, device_class: str = "") -> int:
+        """Bucket id by item name (CrushWrapper::get_item_id), optionally
+        redirected to its device-class shadow."""
+        by_name = {v: k for k, v in self.map.item_names.items()}
+        if name not in by_name:
+            raise ValueError(f"{name!r} is not a named bucket in this map")
+        bid = by_name[name]
+        if device_class:
+            bid = self.get_shadow(bid, device_class)
+        return bid
+
+    def add_erasure_rule(self, root_name: str, choose_steps,
+                         rule_id: Optional[int] = None, name: str = "",
+                         device_class: str = "") -> int:
+        """The canonical EC rule scaffold every plugin's create_rule
+        (ErasureCodeInterface::create_ruleset analog) shares:
+        set_chooseleaf_tries 5, set_choose_tries 100, take
+        <root[~class]>, *choose_steps, emit — rule type erasure."""
+        from .types import (
+            RULE_TYPE_ERASURE,
+            step_emit,
+            step_set_choose_tries,
+            step_set_chooseleaf_tries,
+            step_take,
+        )
+        root = self.resolve_bucket(root_name, device_class)
+        steps = [step_set_chooseleaf_tries(5),
+                 step_set_choose_tries(100), step_take(root),
+                 *choose_steps, step_emit()]
+        if rule_id is None:
+            rule_id = max(self.map.rules, default=-1) + 1
+        return self.add_rule(rule_id, steps, name=name or "erasure",
+                             rule_type=RULE_TYPE_ERASURE)
 
     def add_simple_rule(self, rule_id: int, root: int, failure_domain,
                         n: int = 0, firstn: bool = True,

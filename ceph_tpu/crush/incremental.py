@@ -106,10 +106,17 @@ def apply_incremental(m: OSDMap, inc: Incremental) -> None:
 
     for osd, bits in inc.new_state.items():
         # upstream: int s = new_state ? new_state : CEPH_OSD_UP (a zero
-        # value is the legacy "mark down" encoding); osd_state[osd] ^= s
+        # value is the legacy "mark down" encoding); destroying an
+        # EXISTING osd clears the whole state word (so a later
+        # re-create yields exists+down, never a resurrected up), else
+        # osd_state[osd] ^= s
+        s = bits if bits else CEPH_OSD_UP
         state = ((CEPH_OSD_EXISTS if m.osd_exists[osd] else 0)
                  | (CEPH_OSD_UP if m.osd_up[osd] else 0))
-        state ^= bits if bits else CEPH_OSD_UP
+        if (state & CEPH_OSD_EXISTS) and (s & CEPH_OSD_EXISTS):
+            state = 0
+        else:
+            state ^= s
         m.osd_exists[osd] = bool(state & CEPH_OSD_EXISTS)
         m.osd_up[osd] = bool(state & CEPH_OSD_UP)
         if not m.osd_exists[osd]:

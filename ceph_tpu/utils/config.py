@@ -125,10 +125,12 @@ class ErasureCodeProfileStore:
         profile = {str(k): str(v) for k, v in profile.items()}
         plugin = profile.get("plugin", "jerasure")
         from ..codes.registry import ErasureCodePluginRegistry
+        # validation = instantiation; raises on a bad profile.  The
+        # full profile (crush-* keys included) goes to the plugin, as
+        # the monitor does — plugins ignore what they don't parse, and
+        # create_rule/lrc read the crush-* keys from it.
         payload = {k: v for k, v in profile.items()
-                   if k not in ("plugin", "crush-failure-domain",
-                                "crush-root", "crush-device-class")}
-        # validation = instantiation; raises on a bad profile
+                   if k not in ("plugin", "directory")}
         ErasureCodePluginRegistry.instance().factory(plugin, payload)
         self.profiles[name] = profile
 
@@ -152,7 +154,6 @@ class ErasureCodeProfileStore:
         profile = self.get(name)
         plugin = profile.get("plugin", "jerasure")
         payload = {k: v for k, v in profile.items()
-                   if k not in ("plugin", "crush-failure-domain",
-                                "crush-root", "crush-device-class")}
+                   if k not in ("plugin", "directory")}
         return ErasureCodePluginRegistry.instance().factory(plugin,
                                                             payload)

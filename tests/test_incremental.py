@@ -159,3 +159,17 @@ def test_new_state_zero_means_mark_down():
     m = make_map()
     apply_incremental(m, Incremental(epoch=1, new_state={3: 0}))
     assert not m.is_up(3) and m.exists(3)
+
+
+def test_destroy_then_recreate_comes_back_down():
+    """Upstream destroy special case: (state & EXISTS) && (s & EXISTS)
+    clears the WHOLE state word, so destroy-then-recreate yields an
+    exists+down osd, never a resurrected up one."""
+    m = make_map()
+    assert m.is_up(3)
+    apply_incremental(m, Incremental(epoch=1,
+                                     new_state={3: CEPH_OSD_EXISTS}))
+    assert not m.exists(3)
+    apply_incremental(m, Incremental(epoch=2,
+                                     new_state={3: CEPH_OSD_EXISTS}))
+    assert m.exists(3) and not m.is_up(3)

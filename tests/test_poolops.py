@@ -1,0 +1,149 @@
+"""EC pool creation surface (crush/poolops.py + ErasureCode.create_rule
+— OSDMonitor::prepare_new_pool / crush_rule_create_erasure /
+ErasureCode::create_ruleset analogs): profile → validated plugin →
+generated rule → pool → placements."""
+
+import pytest
+
+from ceph_tpu.crush import CrushBuilder
+from ceph_tpu.crush.osdmap import OSDMap
+from ceph_tpu.crush.poolops import create_erasure_pool
+from ceph_tpu.crush.types import (
+    CRUSH_ITEM_NONE,
+    CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    CRUSH_RULE_SET_CHOOSE_TRIES,
+    RULE_TYPE_ERASURE,
+)
+from ceph_tpu.utils.config import ErasureCodeProfileStore
+
+
+def cluster(n_hosts=12, devs=2):
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    hosts = [b.add_bucket("straw2", "host",
+                          list(range(h * devs, (h + 1) * devs)),
+                          name=f"host{h}")
+             for h in range(n_hosts)]
+    b.add_bucket("straw2", "root", hosts, name="default")
+    return b
+
+
+def test_create_rule_default_shape():
+    """The base-class rule is the canonical EC rule: set_chooseleaf 5,
+    set_choose 100, take root, chooseleaf indep 0 <failure-domain>,
+    emit; type erasure."""
+    b = cluster()
+    store = ErasureCodeProfileStore()
+    store.set("p1", {"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "4", "m": "2",
+                     "crush-failure-domain": "host",
+                     "crush-root": "default"})
+    ec = store.instantiate("p1")
+    rid = ec.create_rule(b, name="p1")
+    rule = b.map.rules[rid]
+    assert rule.type == RULE_TYPE_ERASURE
+    ops = [s[0] for s in rule.steps]
+    assert ops[0] == CRUSH_RULE_SET_CHOOSELEAF_TRIES
+    assert ops[1] == CRUSH_RULE_SET_CHOOSE_TRIES
+    assert (CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1) in rule.steps
+
+
+@pytest.mark.parametrize("profile,expect_n,expect_min", [
+    ({"plugin": "jerasure", "technique": "reed_sol_van",
+      "k": "4", "m": "2"}, 6, 5),
+    ({"plugin": "shec", "k": "6", "m": "3", "c": "2"}, 9, 7),
+    ({"plugin": "clay", "k": "4", "m": "2", "d": "5"}, 6, 5),
+    ({"plugin": "jerasure", "technique": "reed_sol_van",
+      "k": "4", "m": "1"}, 5, 4),    # m=1: min_size = k
+])
+def test_create_erasure_pool_sizes(profile, expect_n, expect_min):
+    b = cluster()
+    m = OSDMap(crush=b.map)
+    store = ErasureCodeProfileStore()
+    store.set("prof", dict(profile,
+                           **{"crush-failure-domain": "host",
+                              "crush-root": "default"}))
+    pool = create_erasure_pool(m, store, "prof", pool_id=7, pg_num=32)
+    assert pool.size == expect_n and pool.min_size == expect_min
+    assert pool.erasure and m.pools[7] is pool
+    # placements flow end to end with EC hole semantics
+    holes = 0
+    for ps in range(32):
+        up, _, _, _ = m.pg_to_up_acting_osds(7, ps)
+        assert len(up) == expect_n
+        holes += sum(o == CRUSH_ITEM_NONE for o in up)
+        hosts = [o // 2 for o in up if o != CRUSH_ITEM_NONE]
+        assert len(hosts) == len(set(hosts))   # failure domains distinct
+    assert holes < 32 * expect_n // 4          # mostly placeable
+
+
+def test_rule_reuse_by_name():
+    """crush_rule_create_erasure reuses an existing same-named rule
+    (the monitor's behavior) instead of stacking duplicates."""
+    b = cluster()
+    m = OSDMap(crush=b.map)
+    store = ErasureCodeProfileStore()
+    store.set("prof", {"plugin": "jerasure", "technique": "reed_sol_van",
+                       "k": "4", "m": "2",
+                       "crush-failure-domain": "host",
+                       "crush-root": "default"})
+    p1 = create_erasure_pool(m, store, "prof", pool_id=1, pg_num=8)
+    p2 = create_erasure_pool(m, store, "prof", pool_id=2, pg_num=8)
+    assert p1.crush_rule == p2.crush_rule
+    assert len(b.map.rules) == 1
+
+
+def test_lrc_profile_routes_to_locality_rule():
+    """An lrc profile with crush-locality goes through lrc's own
+    create_rule override (choose indep over the locality type), not the
+    default single-step rule."""
+    b = cluster()
+    # add racks above the hosts for the locality type
+    b2 = CrushBuilder()
+    b2.add_type(1, "host")
+    b2.add_type(2, "rack")
+    b2.add_type(3, "root")
+    racks, d = [], 0
+    for r in range(2):
+        hs = []
+        for h in range(4):
+            hs.append(b2.add_bucket("straw2", "host", [d, d + 1],
+                                    name=f"r{r}h{h}"))
+            d += 2
+        racks.append(b2.add_bucket("straw2", "rack", hs, name=f"rack{r}"))
+    b2.add_bucket("straw2", "root", racks, name="default")
+    m = OSDMap(crush=b2.map)
+    store = ErasureCodeProfileStore()
+    store.set("lrcp", {"plugin": "lrc", "k": "4", "m": "2", "l": "3",
+                       "crush-locality": "rack",
+                       "crush-failure-domain": "host",
+                       "crush-root": "default"})
+    pool = create_erasure_pool(m, store, "lrcp", pool_id=3, pg_num=16)
+    rule = m.crush.rules[pool.crush_rule]
+    # lrc's rule has TWO choose steps (locality + failure domain)
+    from ceph_tpu.crush.types import CRUSH_RULE_CHOOSE_INDEP
+    assert (CRUSH_RULE_CHOOSE_INDEP, 2, 2) in rule.steps
+    assert pool.size == 8
+
+
+def test_bad_profile_rejected_before_pool_exists():
+    b = cluster()
+    m = OSDMap(crush=b.map)
+    store = ErasureCodeProfileStore()
+    with pytest.raises(ValueError):
+        store.set("bad", {"plugin": "jerasure", "k": "1", "m": "2"})
+    assert "bad" not in store.ls()
+    assert not m.pools
+
+
+def test_builder_from_map_roundtrip():
+    """CrushBuilder.from_map wraps an existing hierarchy: new buckets
+    get fresh negative ids below the existing ones, and type names
+    resolve."""
+    b = cluster(n_hosts=2)
+    b2 = CrushBuilder.from_map(b.map)
+    nb = b2.add_bucket("straw2", "host", [100, 101], name="late")
+    assert nb < min(bid for bid in b.map.buckets if bid != nb)
+    assert b2.type_id("root") == 2
