@@ -60,6 +60,12 @@ from .types import (
     CRUSH_RULE_CHOOSE_FIRSTN,
     CRUSH_RULE_CHOOSE_INDEP,
     CRUSH_RULE_EMIT,
+    CRUSH_RULE_SET_CHOOSELEAF_STABLE,
+    CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    CRUSH_RULE_SET_CHOOSELEAF_VARY_R,
+    CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
+    CRUSH_RULE_SET_CHOOSE_TRIES,
     CRUSH_RULE_TAKE,
     CrushMap,
 )
@@ -468,31 +474,43 @@ def _candidates(cm, take, x, rs, type_, recurse_to_leaf, weight_vec,
     """All candidate picks for an attempt grid ``rs`` in two batched
     descents: the heavy hash work for every (rep, try) is one fused
     computation; only the cheap accept logic stays sequential.
-    ``pos``: choose_args position grid (mapper.c outpos; see callers)."""
-    items, ok = _descend(cm, take, x, rs, type_,
-                         cm.descend_steps(take_type, type_), pos)
+    ``pos``: choose_args position grid (mapper.c outpos; see callers).
+    Returns (items, leaves, ok_domain, ok_full): ok_domain is
+    acceptability BEFORE the leaf recursion (needed by the
+    leaf-retry host-fallback flag, see compile_rule)."""
+    items, ok_dom = _descend(cm, take, x, rs, type_,
+                             cm.descend_steps(take_type, type_), pos)
     if recurse_to_leaf:
         # stable=1 -> recursion rep 0; vary_r=1 -> sub_r = r >> 0
         leaves, lok = _descend(cm, items, x, rs, 0,
                                cm.descend_steps(type_, 0), pos)
         lout = _is_out(weight_vec, leaves, x)
-        ok = ok & lok & ~lout
+        ok = ok_dom & lok & ~lout
     else:
         leaves = items
+        ok = ok_dom
         if type_ == 0:
+            # device reject -> next domain try (exact at one leaf try)
             ok = ok & ~_is_out(weight_vec, items, x)
-    return items, leaves, ok
+            ok_dom = ok
+    return items, leaves, ok_dom, ok
 
 
 def _choose_firstn(cm, take, x, numrep, type_, recurse_to_leaf,
-                   weight_vec, T, take_type):
+                   weight_vec, T, take_type, leaf_retry=False):
     """mapper.c -> crush_choose_firstn, attempt-batched.
 
     Candidate (rep, try) descents are mutually independent (r = rep +
     ftotal depends only on indices), so the whole (numrep, T) grid is
     two batched descents; the sequential part is only the collision /
     first-acceptable scan — identical to the C retry ladder under jewel
-    tunables (no local retries).  Returns (out, count, need_host)."""
+    tunables (no local retries).  Returns (out, count, need_host).
+
+    ``leaf_retry``: the rule SET choose_leaf_tries > 1, so C may
+    salvage a domain candidate whose first leaf pick failed by
+    retrying the recursion; the device models one leaf try, so any
+    lane where a leaf-failed domain candidate precedes the accepted
+    one re-runs on the exact host mapper."""
     rs = (jnp.arange(numrep, dtype=jnp.int64)[:, None]
           + jnp.arange(T, dtype=jnp.int64)[None, :])        # (R, T)
     # choose_args position = outpos at bucket-choose time; bulk keeps
@@ -500,9 +518,9 @@ def _choose_firstn(cm, take, x, numrep, type_, recurse_to_leaf,
     # so outpos == rep for both the domain pick and the leaf recursion
     # (firstn recursion passes the parent outpos through)
     pos = jnp.arange(numrep)[:, None]                       # (R, 1)
-    items, leaves, ok0 = _candidates(cm, take, x, rs, type_,
-                                     recurse_to_leaf, weight_vec,
-                                     take_type, pos)
+    items, leaves, okd0, ok0 = _candidates(cm, take, x, rs, type_,
+                                           recurse_to_leaf, weight_vec,
+                                           take_type, pos)
     out = jnp.full(numrep, NONE, jnp.int32)
     out2 = jnp.full(numrep, NONE, jnp.int32)
     placed_n = jnp.int32(0)
@@ -517,6 +535,13 @@ def _choose_firstn(cm, take, x, numrep, type_, recurse_to_leaf,
             ok = ok & ~lcollide
         first = jnp.argmax(ok)
         any_ok = jnp.any(ok)
+        if leaf_retry and recurse_to_leaf:
+            # a domain-acceptable candidate with a failed leaf at or
+            # before the accepted position: C's leaf retries could
+            # have chosen it instead
+            dok = okd0[rep] & ~collide
+            before = jnp.arange(T) < jnp.where(any_ok, first, T)
+            need_host = need_host | jnp.any(dok & ~ok & before)
         slot = jnp.arange(numrep) == placed_n
         out = jnp.where(slot & any_ok, cand[first], out)
         out2 = jnp.where(slot & any_ok, leaf_cand[first], out2)
@@ -527,12 +552,14 @@ def _choose_firstn(cm, take, x, numrep, type_, recurse_to_leaf,
 
 
 def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
-                  weight_vec, T, take_type):
+                  weight_vec, T, take_type, leaf_retry=False):
     """mapper.c -> crush_choose_indep: candidate grid batched the same
     way; rounds' accept logic sequential.  The per-level r stride
     (numrep, or numrep+1 through a uniform bucket with size % numrep
     == 0) is applied inside _descend from the bucket actually being
-    picked from at each level."""
+    picked from at each level.  ``leaf_retry``: see _choose_firstn —
+    conservatively host-fallbacks any lane with a leaf-failed domain
+    candidate (C's recursion tries could have filled the slot)."""
     base = jnp.broadcast_to(jnp.arange(numrep, dtype=jnp.int64)[None, :],
                             (T, numrep))                       # r = rep
     fs = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int64)[:, None],
@@ -543,11 +570,12 @@ def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
     # choose_args position: crush_choose_indep passes its own outpos
     # (= 0 here, one choose per take) to the domain pick, and rep to
     # the leaf recursion's bucket choose.
-    items, ok0, parent_r = _descend(cm, take, x, base, type_,
-                                    cm.descend_steps(take_type, type_),
-                                    0, indep_f=fs,
-                                    indep_numrep=numrep,
-                                    return_last_r=True)
+    items, okd0, parent_r = _descend(cm, take, x, base, type_,
+                                     cm.descend_steps(take_type, type_),
+                                     0, indep_f=fs,
+                                     indep_numrep=numrep,
+                                     return_last_r=True)
+    need_host = jnp.asarray(False)
     if recurse_to_leaf:
         leaves, lok = _descend(cm, items, x,
                                parent_r + jnp.arange(
@@ -555,9 +583,12 @@ def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
                                0, cm.descend_steps(type_, 0),
                                jnp.arange(numrep)[None, :])
         lout = _is_out(weight_vec, leaves, x)
-        ok0 = ok0 & lok & ~lout
+        ok0 = okd0 & lok & ~lout
+        if leaf_retry:
+            need_host = need_host | jnp.any(okd0 & ~ok0)
     else:
         leaves = items
+        ok0 = okd0
         if type_ == 0:
             ok0 = ok0 & ~_is_out(weight_vec, items, x)
     UNDEF = jnp.int32(-0x7FFFFFFF)
@@ -576,12 +607,13 @@ def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
             out = jnp.where(slot & ok, item, out)
             out2 = jnp.where(slot & ok, leaf, out2)
     res = out2 if recurse_to_leaf else out
-    need_host = jnp.any(res == UNDEF)
+    need_host = need_host | jnp.any(res == UNDEF)
     return jnp.where(res == UNDEF, NONE, res), need_host
 
 
 def _chained_single(cm, takes, count, x, type_, recurse_to_leaf,
-                    weight_vec, T, firstn, from_type):
+                    weight_vec, T, firstn, from_type,
+                    leaf_retry=False):
     """A SECOND choose step over the previous step's output vector
     (mapper.c: per input bucket a fresh segment, outpos=0), numrep=1
     per segment — the common chained EC shape (choose N type rack ->
@@ -609,6 +641,11 @@ def _chained_single(cm, takes, count, x, type_, recurse_to_leaf,
             cm, takes[None, :], x, jnp.zeros_like(fs), type_,
             cm.descend_steps(from_type, type_), 0, indep_f=fs,
             indep_numrep=1, return_last_r=True)
+    in_seg = jnp.arange(R) < count
+    valid_take = takes < 0
+    # an invalid take inside the segment range is skipped entirely by
+    # mapper.c (osize does not advance) — positions shift: host lane
+    need_host = jnp.any(in_seg & ~valid_take)
     if recurse_to_leaf:
         # jewel semantics: recursion rep 0, one leaf try; firstn:
         # sub_r = r (vary_r=1); indep: parent_r = the final pick's r
@@ -616,16 +653,16 @@ def _chained_single(cm, takes, count, x, type_, recurse_to_leaf,
         leaves, lok = _descend(cm, items, x, leaf_r, 0,
                                cm.descend_steps(type_, 0), 0)
         lout = _is_out(weight_vec, leaves, x)
+        ok_dom = ok
         ok = ok & lok & ~lout
+        if leaf_retry:
+            # C's leaf retries could salvage a leaf-failed candidate
+            need_host = need_host | jnp.any(
+                ok_dom & ~ok & (in_seg & valid_take)[None, :])
     else:
         leaves = items
         if type_ == 0:
             ok = ok & ~_is_out(weight_vec, items, x)
-    in_seg = jnp.arange(R) < count
-    valid_take = takes < 0
-    # an invalid take inside the segment range is skipped entirely by
-    # mapper.c (osize does not advance) — positions shift: host lane
-    need_host = jnp.any(in_seg & ~valid_take)
     ok = ok & (in_seg & valid_take)[None, :]
     first = jnp.argmax(ok, axis=0)                       # (R,)
     any_ok = jnp.any(ok, axis=0)
@@ -671,11 +708,46 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
         current = None
         current_type = None  # bucket type the last choose produced
         need_host = jnp.asarray(False)
+        # SET_* rule overrides (the canonical EC rule carries
+        # set_chooseleaf_tries 5 + set_choose_tries 100): the running
+        # values are trace-time constants.  choose_tries caps the
+        # per-step device budget (a SET below T must not let the
+        # device succeed where C's budget ran out); choose_leaf_tries
+        # > 1 turns on the leaf-retry host-fallback flag (the device
+        # models one leaf try; lanes C could salvage re-run exactly
+        # on the host).
+        choose_tries_run = tunables.choose_total_tries + 1
+        leaf_tries_run = 0   # 0 = descend_once default (one try)
         for op, arg1, arg2 in steps:
+            T_step = max(1, min(T, choose_tries_run))
+            leaf_retry = leaf_tries_run > 1
             if op == CRUSH_RULE_TAKE:
                 take = arg1
                 current = None
                 current_type = None
+            elif op == CRUSH_RULE_SET_CHOOSE_TRIES:
+                if arg1 > 0:
+                    choose_tries_run = arg1
+            elif op == CRUSH_RULE_SET_CHOOSELEAF_TRIES:
+                if arg1 > 0:
+                    leaf_tries_run = arg1
+            elif op in (CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
+                        CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+                if arg1 > 0:
+                    raise ValueError(
+                        "bulk evaluator does not fuse local-retry "
+                        "ladders (set_choose_local_* > 0); use "
+                        "engine=host")
+            elif op == CRUSH_RULE_SET_CHOOSELEAF_VARY_R:
+                if arg1 >= 0 and arg1 != 1:
+                    raise ValueError(
+                        "bulk evaluator hardcodes chooseleaf_vary_r=1; "
+                        "use engine=host")
+            elif op == CRUSH_RULE_SET_CHOOSELEAF_STABLE:
+                if arg1 >= 0 and arg1 != 1:
+                    raise ValueError(
+                        "bulk evaluator hardcodes chooseleaf_stable=1; "
+                        "use engine=host")
             elif op in (CRUSH_RULE_CHOOSE_FIRSTN,
                         CRUSH_RULE_CHOOSELEAF_FIRSTN):
                 recurse = op == CRUSH_RULE_CHOOSELEAF_FIRSTN
@@ -687,7 +759,8 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
                             "domain EC shape); use engine=host")
                     vals, nh = _chained_single(
                         cm, current[0], current[1], x, arg2, recurse,
-                        weight_vec, T, True, current_type)
+                        weight_vec, T_step, True, current_type,
+                        leaf_retry=leaf_retry)
                     need_host = need_host | nh
                     current = (vals, current[1])
                     current_type = arg2
@@ -697,8 +770,8 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
                 take_type = (cm.cmap.buckets[take].type
                              if take in cm.cmap.buckets else None)
                 vals, count, nh = _choose_firstn(
-                    cm, take, x, numrep, arg2, recurse, weight_vec, T,
-                    take_type)
+                    cm, take, x, numrep, arg2, recurse, weight_vec,
+                    T_step, take_type, leaf_retry=leaf_retry)
                 need_host = need_host | nh
                 current = (vals, count)
                 current_type = arg2
@@ -713,7 +786,8 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
                             "domain EC shape); use engine=host")
                     vals, nh = _chained_single(
                         cm, current[0], current[1], x, arg2, recurse,
-                        weight_vec, T, False, current_type)
+                        weight_vec, T_step, False, current_type,
+                        leaf_retry=leaf_retry)
                     need_host = need_host | nh
                     current = (vals, current[1])
                     current_type = arg2
@@ -723,8 +797,8 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
                 take_type = (cm.cmap.buckets[take].type
                              if take in cm.cmap.buckets else None)
                 vals, nh = _choose_indep(
-                    cm, take, x, numrep, arg2, recurse, weight_vec, T,
-                    take_type)
+                    cm, take, x, numrep, arg2, recurse, weight_vec,
+                    T_step, take_type, leaf_retry=leaf_retry)
                 need_host = need_host | nh
                 current = (vals, jnp.int32(vals.shape[0]))
                 current_type = arg2

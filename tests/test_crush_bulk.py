@@ -507,3 +507,107 @@ def test_uniform_chained_choose_matches_host():
                    step_chooseleaf_indep(1, 1), step_emit()])
     pin(b, 0, 2)
     pin(b, 1, 2)
+
+
+# -- SET_* steps (the canonical EC rule shape) ---------------------------
+
+def _ec_rule_map(n_hosts=8, devs=2):
+    """Map + the canonical erasure rule (set_chooseleaf_tries 5,
+    set_choose_tries 100, take, chooseleaf indep 0 host, emit) the mon
+    generates for every EC pool."""
+    from ceph_tpu.crush.types import (step_set_choose_tries,
+                                      step_set_chooseleaf_tries)
+    b, root = build(n_hosts, devs)
+    b.add_rule(0, [step_set_chooseleaf_tries(5),
+                   step_set_choose_tries(100),
+                   step_take(root),
+                   step_chooseleaf_indep(0, 1),
+                   step_emit()])
+    return b
+
+
+def test_bulk_canonical_ec_rule_matches_host():
+    """Every real-world EC rule carries the SET steps; the fused
+    evaluator previously rejected them wholesale (found driving
+    osdmaptool --create-ec-pool + --test-map-pgs --engine bulk)."""
+    b = _ec_rule_map()
+    pin(b, 0, 4)
+
+
+def test_bulk_canonical_ec_rule_with_reweights():
+    """Reweights make leaf picks fail, exercising the leaf-retry
+    host-fallback path (choose_leaf_tries=5 > 1: C can salvage a
+    domain candidate by retrying its recursion; those lanes must
+    re-run on the host, not diverge)."""
+    b = _ec_rule_map()
+    w = [0x10000] * b.map.max_devices
+    w[1] = 0
+    w[4] = 0x4000
+    w[9] = 0x8000
+    w[12] = 0
+    pin(b, 0, 4, weight=w)
+
+
+def test_bulk_set_choose_tries_low_cap():
+    """set_choose_tries BELOW the device budget: the device must not
+    succeed where C's budget ran out (T is capped per step)."""
+    from ceph_tpu.crush.types import step_set_choose_tries
+    b, root = build(3, 2)
+    b.add_rule(0, [step_set_choose_tries(2), step_take(root),
+                   step_chooseleaf_firstn(3, 1), step_emit()])
+    w = [0x10000] * b.map.max_devices
+    w[2] = w[3] = 0          # kill a host: collisions + retries
+    pin(b, 0, 3, weight=w)
+
+
+def test_bulk_set_firstn_ec_shape_and_chained():
+    """SET steps with firstn and with the chained EC shape."""
+    from ceph_tpu.crush.types import (step_set_choose_tries,
+                                      step_set_chooseleaf_tries)
+    rng = np.random.default_rng(5)
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "rack")
+    b.add_type(3, "root")
+    racks = []
+    d = 0
+    for r in range(3):
+        hosts = []
+        for _h in range(3):
+            nd = 2
+            ws = [int(v) for v in rng.integers(0x8000, 0x20000, nd)]
+            hosts.append(b.add_bucket("straw2", "host",
+                                      list(range(d, d + nd)), ws))
+            d += nd
+        racks.append(b.add_bucket("straw2", "rack", hosts))
+    root = b.add_bucket("straw2", "root", racks)
+    b.add_rule(0, [step_set_chooseleaf_tries(5),
+                   step_set_choose_tries(100), step_take(root),
+                   step_chooseleaf_firstn(0, 1), step_emit()])
+    b.add_rule(1, [step_set_chooseleaf_tries(5),
+                   step_set_choose_tries(100), step_take(root),
+                   step_choose_indep(2, 2),
+                   step_chooseleaf_indep(1, 1), step_emit()])
+    w = [0x10000] * b.map.max_devices
+    w[3] = 0x6000
+    pin(b, 0, 3, weight=w)
+    pin(b, 1, 2, weight=w)
+
+
+def test_bulk_set_vary_r_stable_overrides_gate():
+    from ceph_tpu.crush.types import (CRUSH_RULE_SET_CHOOSELEAF_STABLE,
+                                      CRUSH_RULE_SET_CHOOSELEAF_VARY_R)
+    b, root = build(3, 2)
+    b.add_rule(0, [(CRUSH_RULE_SET_CHOOSELEAF_VARY_R, 0, 0),
+                   step_take(root), step_chooseleaf_firstn(3, 1),
+                   step_emit()])
+    with pytest.raises(ValueError, match="vary_r"):
+        bulk.bulk_do_rule(b.map, 0, np.arange(4), 3)
+    b.add_rule(1, [(CRUSH_RULE_SET_CHOOSELEAF_STABLE, 0, 0),
+                   step_take(root), step_chooseleaf_firstn(3, 1),
+                   step_emit()])
+    with pytest.raises(ValueError, match="stable"):
+        bulk.bulk_do_rule(b.map, 1, np.arange(4), 3)
+    from ceph_tpu.crush import crush_do_rule as host
+    assert host(b.map, 0, 0, 3) is not None    # host handles both
+    assert host(b.map, 1, 0, 3) is not None

@@ -198,6 +198,13 @@ def gen_map(seed: int, bulk_ok: bool):
     b.add_rule(4, [step_take(racks[0]),
                    step_choose_firstn(0, host_t), step_emit()])
     rules = [0, 1, 2, 3, 4]
+    if bulk_ok:
+        # the canonical EC rule shape (mon-generated): SET steps fuse
+        # since r04 (leaf-retry lanes host-fallback)
+        b.add_rule(5, [step_set_chooseleaf_tries(5),
+                       step_set_choose_tries(100), step_take(root),
+                       step_chooseleaf_indep(0, host_t), step_emit()])
+        rules.append(5)
     if not bulk_ok:
         # SET_* overrides, a device take + multi-emit, choose-to-osd
         b.add_rule(5, [step_set_choose_tries(int(rng.integers(5, 60))),
