@@ -227,8 +227,20 @@ def main(argv=None) -> int:
     p.add_argument("--engine", choices=("host", "bulk"), default="bulk")
     p.add_argument("--createsimple", type=int, metavar="N")
     p.add_argument("--pg-num", type=int, default=128,
-                   help="pg_num for --createsimple pools")
-    p.add_argument("-o", "--outfn", help="output map for --createsimple")
+                   help="pg_num for --createsimple / --create-ec-pool")
+    p.add_argument("--create-ec-pool", metavar="NAME",
+                   help="create an erasure pool from an EC profile "
+                        "(mon analog: profile -> plugin rule -> pool); "
+                        "writes the updated map to -o (or in place)")
+    p.add_argument("--ec-profile", action="append", default=[],
+                   metavar="K=V",
+                   help="EC profile entry for --create-ec-pool "
+                        "(repeatable; e.g. plugin=jerasure k=4 m=2 "
+                        "crush-failure-domain=host crush-root=default)")
+    p.add_argument("--pool-id", type=int, default=None,
+                   help="pool id for --create-ec-pool (default: next)")
+    p.add_argument("-o", "--outfn",
+                   help="output map for --createsimple/--create-ec-pool")
     a = p.parse_args(argv)
 
     if a.createsimple:
@@ -238,6 +250,33 @@ def main(argv=None) -> int:
     if not a.mapfn:
         p.error("an OSDMap JSON file is required")
     m = load_osdmap(a.mapfn)
+    if a.create_ec_pool:
+        from ..crush.poolops import create_erasure_pool
+        from ..utils.config import ErasureCodeProfileStore
+        profile = {}
+        for kv in a.ec_profile:
+            if "=" not in kv:
+                p.error(f"--ec-profile {kv!r} is not K=V")
+            k, _, v = kv.partition("=")
+            profile[k] = v
+        store = ErasureCodeProfileStore()
+        try:
+            store.set(a.create_ec_pool, profile)
+            pool_id = (a.pool_id if a.pool_id is not None
+                       else max(m.pools, default=0) + 1)
+            pool = create_erasure_pool(m, store, a.create_ec_pool,
+                                       pool_id=pool_id, pg_num=a.pg_num)
+        except (ValueError, KeyError, OSError) as e:
+            # OSError: the registry's dlopen-analog load of an unknown
+            # plugin module
+            raise SystemExit(f"osdmaptool: --create-ec-pool: {e}")
+        out_fn = a.outfn or a.mapfn
+        json.dump(dump_osdmap(m, list(m.pools.values())),
+                  open(out_fn, "w"), indent=1)
+        print(f"osdmaptool: created erasure pool {pool.pool_id} "
+              f"(size={pool.size} min_size={pool.min_size} "
+              f"rule={pool.crush_rule}) in {out_fn}")
+        return 0
     pool_ids = a.pool or sorted(m.pools)
     for pid in pool_ids:
         if pid not in m.pools:

@@ -39,6 +39,10 @@ def create_erasure_pool(m: OSDMap, store, profile_name: str,
     - the rule goes into the OSDMap's own crush hierarchy (wrapped
       with CrushBuilder.from_map) and the pool references it.
     """
+    if pool_id in m.pools:
+        # OSDMonitor::prepare_new_pool refuses duplicates; silently
+        # replacing a pool would destroy its definition
+        raise ValueError(f"pool {pool_id} already exists")
     ec = store.instantiate(profile_name)
     builder = CrushBuilder.from_map(m.crush)
     rid = crush_rule_create_erasure(builder, rule_name or profile_name,

@@ -31,15 +31,19 @@ def random_map(seed: int):
     b.add_type(1, "host")
     b.add_type(2, "rack")
     b.add_type(10, "root")
-    algs = ["straw2", "straw", "list", "tree"]
+    algs = ["straw2", "straw", "list", "tree", "uniform"]
     racks = []
     d = 0
     for r in range(int(rng.integers(2, 4))):
         hosts = []
         for h in range(int(rng.integers(2, 4))):
             nd = int(rng.integers(1, 4))
-            ws = [int(w) for w in rng.integers(0x8000, 0x28000, nd)]
             alg = algs[int(rng.integers(0, len(algs)))]
+            if alg == "uniform":
+                # wire format carries ONE item_weight for uniform
+                ws = [int(rng.integers(0x8000, 0x28000))] * nd
+            else:
+                ws = [int(w) for w in rng.integers(0x8000, 0x28000, nd)]
             hosts.append(b.add_bucket(alg, "host",
                                       list(range(d, d + nd)), ws))
             d += nd

@@ -147,3 +147,16 @@ def test_builder_from_map_roundtrip():
     nb = b2.add_bucket("straw2", "host", [100, 101], name="late")
     assert nb < min(bid for bid in b.map.buckets if bid != nb)
     assert b2.type_id("root") == 2
+
+
+def test_create_erasure_pool_refuses_duplicate_id():
+    b = cluster()
+    m = OSDMap(crush=b.map)
+    store = ErasureCodeProfileStore()
+    store.set("prof", {"plugin": "jerasure", "technique": "reed_sol_van",
+                       "k": "4", "m": "2",
+                       "crush-failure-domain": "host",
+                       "crush-root": "default"})
+    create_erasure_pool(m, store, "prof", pool_id=1, pg_num=8)
+    with pytest.raises(ValueError, match="already exists"):
+        create_erasure_pool(m, store, "prof", pool_id=1, pg_num=8)

@@ -187,3 +187,64 @@ def test_osdmaptool_missing_pool_field_clean_error(tmp_path):
     r = run("ceph_tpu.bench.osdmaptool", mapfn, "--test-map-pgs")
     assert r.returncode != 0
     assert "missing required" in r.stderr and "Traceback" not in r.stderr
+
+
+def test_osdmaptool_create_ec_pool(tmp_path):
+    """profile -> rule -> pool via the CLI (mon-analog flow), then the
+    created pool places through --test-map-pgs."""
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "8",
+        "--pg-num", "16", "-o", mapfn)
+    r = run("ceph_tpu.bench.osdmaptool", mapfn,
+            "--create-ec-pool", "ecprof",
+            "--ec-profile", "plugin=jerasure",
+            "--ec-profile", "technique=reed_sol_van",
+            "--ec-profile", "k=4", "--ec-profile", "m=2",
+            "--ec-profile", "crush-failure-domain=host",
+            "--ec-profile", "crush-root=root",
+            "--pg-num", "32")
+    assert r.returncode == 0, r.stderr
+    assert "size=6 min_size=5" in r.stdout
+    spec = json.load(open(mapfn))
+    ec_pools = [p for p in spec["pools"] if p["erasure"]]
+    assert len(ec_pools) == 1 and ec_pools[0]["size"] == 6
+    r = run("ceph_tpu.bench.osdmaptool", mapfn, "--test-map-pgs",
+            "--pool", str(ec_pools[0]["pool_id"]), "--engine", "host")
+    assert r.returncode == 0, r.stderr
+    assert "mapped 32 pgs" in r.stdout
+
+
+def test_osdmaptool_create_ec_pool_bad_profile_clean(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "4", "-o", mapfn)
+    r = run("ceph_tpu.bench.osdmaptool", mapfn,
+            "--create-ec-pool", "bad",
+            "--ec-profile", "plugin=jerasure", "--ec-profile", "k=1",
+            "--ec-profile", "m=2")
+    assert r.returncode != 0
+    assert "Traceback" not in r.stderr
+
+
+def test_osdmaptool_create_ec_pool_unknown_plugin_clean(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "4", "-o", mapfn)
+    r = run("ceph_tpu.bench.osdmaptool", mapfn,
+            "--create-ec-pool", "x", "--ec-profile", "plugin=nope")
+    assert r.returncode != 0 and "Traceback" not in r.stderr
+    assert "--create-ec-pool" in r.stderr
+
+
+def test_osdmaptool_create_ec_pool_refuses_duplicate_id(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "4", "-o", mapfn)
+    r = run("ceph_tpu.bench.osdmaptool", mapfn,
+            "--create-ec-pool", "p", "--pool-id", "1",
+            "--ec-profile", "plugin=jerasure",
+            "--ec-profile", "technique=reed_sol_van",
+            "--ec-profile", "k=4", "--ec-profile", "m=2",
+            "--ec-profile", "crush-root=root",
+            "--ec-profile", "crush-failure-domain=host")
+    assert r.returncode != 0 and "already exists" in r.stderr
+    # the original pool survives untouched
+    spec = json.load(open(mapfn))
+    assert spec["pools"][0]["erasure"] is False
