@@ -15,6 +15,13 @@ incremental surgery needs a mon and is out of scope, SURVEY.md §7):
   python -m ceph_tpu.bench.osdmaptool --createsimple N -o MAP
       build a fresh map with N osds (one host each), a replicated
       pool, and jewel tunables (osdmaptool --createsimple analog).
+  python -m ceph_tpu.bench.osdmaptool MAP --print
+      map summary: epoch, pools, per-osd up/in/weight lines
+      (osdmaptool --print; combinable with the modes above).
+  python -m ceph_tpu.bench.osdmaptool MAP --create-ec-pool NAME
+      --ec-profile K=V ... [--pool-id N] [--pg-num M] [-o OUT]
+      validate an EC profile, let the plugin emit its CRUSH rule, and
+      add the pool (mon prepare_new_pool analog).
 
 MAP is a JSON document:
   {"crush": <crush map in this framework's JSON interchange form, or
@@ -120,6 +127,32 @@ def dump_osdmap(m: OSDMap, pools) -> Dict:
     return out
 
 
+def print_map(m: OSDMap) -> int:
+    """osdmaptool --print: epoch, pools, per-osd state lines."""
+    print(f"epoch {m.epoch}")
+    print(f"max_osd {m.max_osd}")
+    for pid in sorted(m.pools):
+        p = m.pools[pid]
+        kind = "erasure" if p.erasure else "replicated"
+        print(f"pool {pid} '{kind}' size {p.size} min_size {p.min_size} "
+              f"crush_rule {p.crush_rule} pg_num {p.pg_num} "
+              f"pgp_num {p.pgp_num}")
+    for osd in range(m.max_osd):
+        if not m.osd_exists[osd]:
+            continue
+        state = "up" if m.osd_up[osd] else "down"
+        inout = "out" if m.osd_weight[osd] == 0 else "in"
+        w = m.osd_weight[osd] / IN_WEIGHT
+        print(f"osd.{osd} {state} {inout} weight {w:g}")
+    n_over = (len(m.pg_upmap) + len(m.pg_upmap_items)
+              + len(m.pg_temp) + len(m.primary_temp))
+    if n_over:
+        print(f"{len(m.pg_upmap)} pg_upmap, {len(m.pg_upmap_items)} "
+              f"pg_upmap_items, {len(m.pg_temp)} pg_temp, "
+              f"{len(m.primary_temp)} primary_temp")
+    return 0
+
+
 def test_map_pgs(m: OSDMap, pool_ids, engine: str) -> int:
     total = np.zeros(m.max_osd, dtype=np.int64)
     first = np.zeros(m.max_osd, dtype=np.int64)
@@ -217,6 +250,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="osdmaptool",
                                 description=__doc__.split("\n")[0])
     p.add_argument("mapfn", nargs="?", help="OSDMap JSON file")
+    p.add_argument("--print", action="store_true", dest="print_map",
+                   help="print a map summary (osdmaptool --print)")
     p.add_argument("--test-map-pgs", action="store_true")
     p.add_argument("--upmap", metavar="OUT",
                    help="write pg-upmap-items commands ('-' = stdout)")
@@ -277,6 +312,11 @@ def main(argv=None) -> int:
               f"(size={pool.size} min_size={pool.min_size} "
               f"rule={pool.crush_rule}) in {out_fn}")
         return 0
+    if a.print_map:
+        # the reference performs --print ALONGSIDE other modes
+        print_map(m)
+        if not (a.test_map_pgs or a.upmap):
+            return 0
     pool_ids = a.pool or sorted(m.pools)
     for pid in pool_ids:
         if pid not in m.pools:
@@ -286,7 +326,8 @@ def main(argv=None) -> int:
     if a.upmap:
         return upmap(m, pool_ids, a.upmap, a.upmap_deviation,
                      a.upmap_max, a.engine)
-    p.error("nothing to do (--test-map-pgs / --upmap / --createsimple)")
+    p.error("nothing to do (--print / --test-map-pgs / --upmap / "
+            "--createsimple / --create-ec-pool)")
     return 2
 
 

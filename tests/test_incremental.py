@@ -173,3 +173,56 @@ def test_destroy_then_recreate_comes_back_down():
     apply_incremental(m, Incremental(epoch=2,
                                      new_state={3: CEPH_OSD_EXISTS}))
     assert m.exists(3) and not m.is_up(3)
+
+
+def test_randomized_delta_streams_match_direct_edits():
+    """Fuzz: random epoch-ordered delta streams vs the same mutations
+    applied directly — placements must match pg-for-pg after every
+    epoch (the property the mon's publication model rests on)."""
+    rng = np.random.default_rng(20260730)
+    for trial in range(6):
+        m_inc = make_map(pg_num=24)
+        m_dir = make_map(pg_num=24)
+        epoch = 0
+        for _ in range(10):
+            epoch += 1
+            inc = Incremental(epoch=epoch)
+            kind = rng.integers(0, 5)
+            osd = int(rng.integers(0, m_dir.max_osd))
+            seed = m_dir.pools[1].raw_pg_to_pg(int(rng.integers(0, 24)))
+            if kind == 0:      # up/down toggle
+                inc.new_state[osd] = CEPH_OSD_UP
+                m_dir.osd_up[osd] = not m_dir.osd_up[osd]
+            elif kind == 1:    # reweight
+                w = int(rng.integers(0, 0x10001))
+                inc.new_weight[osd] = w
+                m_dir.osd_weight[osd] = w
+                if w:
+                    m_dir.osd_exists[osd] = True
+            elif kind == 2:    # affinity
+                aff = int(rng.integers(0, 0x10001))
+                inc.new_primary_affinity[osd] = aff
+                m_dir.set_primary_affinity(osd, aff)
+            elif kind == 3:    # pg_temp set/remove
+                if (1, seed) in m_dir.pg_temp and rng.random() < 0.5:
+                    inc.new_pg_temp[(1, seed)] = []
+                    m_dir.pg_temp.pop((1, seed), None)
+                else:
+                    temp = [int(o) for o in rng.choice(
+                        m_dir.max_osd, 3, replace=False)]
+                    inc.new_pg_temp[(1, seed)] = list(temp)
+                    m_dir.pg_temp[(1, seed)] = list(temp)
+            else:              # upmap items set/remove
+                if (1, seed) in m_dir.pg_upmap_items and rng.random() < 0.5:
+                    inc.old_pg_upmap_items.append((1, seed))
+                    m_dir.pg_upmap_items.pop((1, seed), None)
+                else:
+                    pair = (int(rng.integers(0, m_dir.max_osd)),
+                            int(rng.integers(0, m_dir.max_osd)))
+                    inc.new_pg_upmap_items[(1, seed)] = [pair]
+                    m_dir.pg_upmap_items[(1, seed)] = [pair]
+            apply_incremental(m_inc, inc)
+            for ps in range(24):
+                assert (m_inc.pg_to_up_acting_osds(1, ps)
+                        == m_dir.pg_to_up_acting_osds(1, ps)), \
+                    (trial, epoch, ps, kind)

@@ -248,3 +248,19 @@ def test_osdmaptool_create_ec_pool_refuses_duplicate_id(tmp_path):
     # the original pool survives untouched
     spec = json.load(open(mapfn))
     assert spec["pools"][0]["erasure"] is False
+
+
+def test_osdmaptool_print(tmp_path):
+    mapfn = str(tmp_path / "map.json")
+    run("ceph_tpu.bench.osdmaptool", "--createsimple", "4",
+        "--pg-num", "16", "-o", mapfn)
+    spec = json.load(open(mapfn))
+    spec["osd_out"] = [2]
+    spec["osd_down"] = [2]
+    json.dump(spec, open(mapfn, "w"))
+    r = run("ceph_tpu.bench.osdmaptool", mapfn, "--print")
+    assert r.returncode == 0, r.stderr
+    assert "epoch 0" in r.stdout and "max_osd 4" in r.stdout
+    assert "pool 1 'replicated' size 3" in r.stdout
+    assert "osd.2 down out weight 0" in r.stdout
+    assert "osd.0 up in weight 1" in r.stdout
