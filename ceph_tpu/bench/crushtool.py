@@ -77,6 +77,17 @@ def main(argv=None) -> int:
     p.add_argument("--weight", nargs=2, action="append", default=[],
                    metavar=("DEV", "W"),
                    help="override device weight (float, 1.0 = in)")
+    # crushtool edit surface (CrushWrapper insert/remove/adjust)
+    p.add_argument("--add-item", nargs=3, metavar=("ID", "W", "NAME"),
+                   help="add device ID with weight W (float) named "
+                        "NAME into the bucket given by --loc")
+    p.add_argument("--loc", nargs=2, metavar=("TYPE", "NAME"),
+                   help="location bucket for --add-item")
+    p.add_argument("--remove-item", metavar="NAME",
+                   help="remove the named item from every bucket")
+    p.add_argument("--reweight-item", nargs=2, metavar=("NAME", "W"),
+                   help="set the named item's weight (float) everywhere "
+                        "and repropagate ancestors")
     args = p.parse_args(argv)
 
     if args.decompile:
@@ -97,6 +108,56 @@ def main(argv=None) -> int:
         cmap = b.map
     if cmap is None:
         p.error("need -i MAP or --build-two-level")
+
+    if args.add_item or args.remove_item or args.reweight_item:
+        b = CrushBuilder.from_map(cmap)
+
+        def item_of(name):
+            # fresh lookup per call: an --add-item earlier in the SAME
+            # invocation must be visible to --reweight-item/--remove
+            for iid, nm in cmap.item_names.items():
+                if nm == name:
+                    return iid
+            p.error(f"no item named {name!r} in map")
+
+        try:
+            if args.add_item:
+                dev, w, name = args.add_item
+                dev = int(dev)
+                if not args.loc:
+                    p.error("--add-item requires --loc TYPE NAME")
+                if any(dev in bk.items for bk in cmap.buckets.values()):
+                    p.error(f"item {dev} already exists in the map "
+                            "(CrushWrapper::insert_item rejects "
+                            "duplicates)")
+                if name in cmap.item_names.values():
+                    p.error(f"name {name!r} already used in the map")
+                loc_type, loc_name = args.loc
+                target = item_of(loc_name)
+                if target >= 0:
+                    p.error(f"--loc: {loc_name!r} is a device, not a "
+                            f"bucket")
+                bt = cmap.buckets[target].type
+                if cmap.type_names.get(bt) != loc_type:
+                    p.error(f"--loc: {loc_name!r} is a "
+                            f"{cmap.type_names.get(bt)!r}, not "
+                            f"{loc_type!r}")
+                b.insert_item(dev, int(float(w) * 0x10000),
+                              target, name=name)
+                print(f"add_item {dev} weight {w} to {loc_name}",
+                      file=sys.stderr)
+            if args.remove_item:
+                n = b.remove_item(item_of(args.remove_item))
+                print(f"remove_item {args.remove_item}: {n} buckets "
+                      f"changed", file=sys.stderr)
+            if args.reweight_item:
+                name, w = args.reweight_item
+                n = b.adjust_item_weight(item_of(name),
+                                         int(float(w) * 0x10000))
+                print(f"reweight_item {name} -> {w}: {n} buckets "
+                      f"changed", file=sys.stderr)
+        except (ValueError, KeyError) as e:
+            raise SystemExit(f"crushtool: {e}")
 
     if args.outfn:
         fmt = args.format

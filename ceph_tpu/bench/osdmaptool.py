@@ -285,6 +285,10 @@ def main(argv=None) -> int:
     if not a.mapfn:
         p.error("an OSDMap JSON file is required")
     m = load_osdmap(a.mapfn)
+    if a.print_map and a.create_ec_pool:
+        # --print composes with every mode; the pool-create branch
+        # returns early, so summarize the BEFORE state here
+        print_map(m)
     if a.create_ec_pool:
         from ..crush.poolops import create_erasure_pool
         from ..utils.config import ErasureCodeProfileStore

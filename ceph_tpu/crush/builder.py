@@ -423,7 +423,9 @@ class CrushBuilder:
             changed += 1
         if item < 0:
             self.map.buckets.pop(item, None)
-            self.map.item_names.pop(item, None)
+        # CrushWrapper::remove_item erases the name map entry for
+        # devices and buckets alike
+        self.map.item_names.pop(item, None)
         self.map.device_classes.pop(item, None)
         if changed and self.map.class_bucket:
             self.populate_classes()
