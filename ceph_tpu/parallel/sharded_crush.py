@@ -41,7 +41,8 @@ def sharded_bulk_do_rule(mesh: Mesh, cmap, ruleno: int, xs,
           else bulk.CompiledCrushMap(cmap, choose_args))
     if weight is None:
         weight = cm.cmap.device_weights()
-    tries = bulk_tries if bulk_tries else bulk.DEFAULT_BULK_TRIES
+    tries = (bulk_tries if bulk_tries
+             else bulk.auto_tries(cm.cmap, ruleno, result_max))
     fn = bulk.compile_rule(cm, ruleno, result_max, tries)
     n_dev = mesh.shape[axis]
     xs = np.asarray(xs, dtype=np.int64)

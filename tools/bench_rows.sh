@@ -62,3 +62,6 @@ run_row "row 4b: jerasure RS decode, packed layout" \
 
 run_row "row 5: 1M-PG bulk CRUSH sweep on device" \
     python tools/bulk_crush_row.py
+
+run_row "row 5b: 1M-PG bulk CRUSH sweep, canonical EC rule (SET steps)" \
+    python tools/bulk_crush_row.py --ec
