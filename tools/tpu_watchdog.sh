@@ -1,0 +1,66 @@
+#!/bin/sh
+# Opportunistic TPU bench watchdog (VERDICT r04 Next#1).
+#
+# The axon tunnel has been down for two consecutive round-end bench
+# runs, so the official artifact has carried value=null twice while the
+# kernels' only device numbers live in a hand-seeded last-good record.
+# This script stops treating the bench as an end-of-round event: run it
+# in a tmux/background session for the WHOLE round; every PERIOD
+# seconds it probes device init in a killable subprocess, and the
+# moment the tunnel is up it immediately runs the full capture:
+#
+#   1. python bench.py            -> BENCH_LAST_GOOD.json (real git sha)
+#   2. sh tools/bench_rows.sh     -> BENCH_ROWS_LAST_GOOD.jsonl per row
+#
+# After a successful capture it keeps probing at a longer interval so a
+# later commit (e.g. a kernel improvement landed mid-round) refreshes
+# the record too.  All activity is appended to tools/watchdog.log; a
+# successful capture also drops tools/WATCHDOG_CAPTURED with the sha so
+# the builder can see at a glance that a device number exists.
+#
+# Reference role: src/test/erasure-code/ceph_erasure_code_benchmark.cc
+# is the metric source this feeds (SURVEY.md §2.1 row 20).
+
+set -u
+cd "$(dirname "$0")/.."
+
+LOG=tools/watchdog.log
+MARKER=tools/WATCHDOG_CAPTURED
+PERIOD=${WATCHDOG_PERIOD:-900}          # probe cadence while down
+PERIOD_AFTER=${WATCHDOG_PERIOD_AFTER:-3600}  # cadence after a capture
+PROBE_TIMEOUT=${WATCHDOG_PROBE_TIMEOUT:-100}
+
+log() {
+    printf '%s %s\n' "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$*" >> "$LOG"
+}
+
+probe() {
+    # device init hangs uninterruptibly inside the PJRT client when the
+    # tunnel is wedged — the probe must be killable from outside
+    timeout "$PROBE_TIMEOUT" python -c \
+        "import jax; print(len(jax.devices()))" >/dev/null 2>&1
+}
+
+log "watchdog start (pid $$, period ${PERIOD}s)"
+while :; do
+    if probe; then
+        SHA=$(git rev-parse --short=12 HEAD 2>/dev/null || echo unknown)
+        log "tunnel UP at sha $SHA — running full capture"
+        if timeout 3600 python bench.py >> "$LOG" 2>&1; then
+            log "bench.py done"
+        else
+            log "bench.py FAILED (rc $?)"
+        fi
+        if timeout 5400 sh tools/bench_rows.sh >> "$LOG" 2>&1; then
+            log "bench_rows.sh done"
+            printf '%s %s\n' "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$SHA" \
+                >> "$MARKER"
+        else
+            log "bench_rows.sh FAILED (rc $?)"
+        fi
+        sleep "$PERIOD_AFTER"
+    else
+        log "tunnel down (probe ${PROBE_TIMEOUT}s)"
+        sleep "$PERIOD"
+    fi
+done
