@@ -62,7 +62,11 @@ DECODE_ROWS = [
       "--parameter", "k=8", "--parameter", "m=3", "--size", str(1 << 20),
       "--workload", "decode", "-e", "2",
       "--device", "jax", "--batch", "64", "--loop", "1024",
-      "--layout", "packed"]),
+      "--layout", "packed", "--chain", "slice"]),
+    # shec/clay decode is pure-XLA (no Pallas step), so the slice
+    # chain is INVALID for them — XLA would narrow the decode to the
+    # one sliced element and the number would be fiction; they keep
+    # the conservative carry chain (see build_chain docstring).
     ("shec_k6_m3_c2_e1",
      ["--plugin", "shec", "--parameter", "k=6", "--parameter", "m=3",
       "--parameter", "c=2", "--size", str(6 * 131072),
@@ -213,13 +217,22 @@ def main() -> int:
     # repacking inside the chain).
     candidates = []
     errors = []
-    for layout in ("packed", "bytes"):
+    # chain=slice carries one element between steps, so measured HBM
+    # traffic is exactly the encode's own read+write (1.375x input at
+    # k=8 m=3) — the roofline-honest throughput; chain=carry XOR-folds
+    # full parities (2.5x input traffic, stream-ceiling bound) and is
+    # kept for continuity with the r02-r04 numbers (tools/roofline.py
+    # separates the terms; docs/PERF.md has the table).
+    for layout, chain in (("packed", "slice"), ("packed", "carry"),
+                          ("bytes", "carry")):
         try:
             candidates.append(_run(NORTH_STAR + [
                 "--device", "jax", "--batch", "64",
-                "--loop", "1024", "--layout", layout]))
+                "--loop", "1024", "--layout", layout,
+                "--chain", chain]))
         except Exception as e:  # noqa: BLE001 - recorded in error line
-            errors.append(f"encode/{layout}: {type(e).__name__}: {e}")
+            errors.append(f"encode/{layout}/{chain}: "
+                          f"{type(e).__name__}: {e}")
     # per-call (includes tunnel dispatch latency), for continuity
     try:
         percall = _run(NORTH_STAR + ["--device", "jax", "--batch", "64",
@@ -260,6 +273,11 @@ def main() -> int:
         "baseline": cpp_src,
         "baseline_gbps": round(cpp_gbps, 3),
         "layout": best.get("layout", "bytes"),
+        "chain": best.get("chain", "carry"),
+        "carry_chain_gbps": max(
+            (round(c["gbps"], 3) for c in candidates
+             if c.get("chain") == "carry" and c.get("loop")),
+            default=None),
         "percall_gbps": round(percall["gbps"], 3) if percall else None,
         "decode_gbps": decode_rows.get("rs_k8_m3_e2"),
         "decode_rows": decode_rows,

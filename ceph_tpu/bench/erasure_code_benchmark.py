@@ -48,6 +48,50 @@ def _parse_parameters(params: List[str]) -> Dict[str, str]:
     return profile
 
 
+def build_chain(op, chain: str, packed: bool, full_init_of, reps: int):
+    """The ONE chained-scan harness shared by the encode path, the
+    decode path, and tools/roofline.py's kernel/harness probes (so the
+    roofline numbers and the bench numbers are the same computation by
+    construction).
+
+    op: slab -> output (encode or decode step).
+    chain='carry': XOR-fold full outputs into the scan carry
+    (full_init_of(slabs) supplies the zero carry) — adds 3
+    output-sized HBM streams per step.  chain='slice': carry one
+    element (outputs 4-dim when packed, 3-dim otherwise), so the
+    chain's traffic is exactly the op's own read+write; only valid
+    when op is opaque to XLA DCE (a Pallas call) — a pure-XLA op would
+    be narrowed to the sliced element and the number would be fiction.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if chain == "slice":
+        def step(carry, slab):
+            out = op(slab)
+            sl = out[:1, :1, :1, :1] if packed else out[:1, :1, :1]
+            return carry ^ sl.reshape(()), None
+
+        def init_of(slabs):
+            return jnp.zeros((), slabs.dtype)
+    else:
+        def step(carry, slab):
+            return carry ^ op(slab), None
+
+        init_of = full_init_of
+
+    @jax.jit
+    def chained(slabs):
+        def rep(carry, _):
+            c, _ = jax.lax.scan(step, carry, slabs)
+            return c, None
+
+        out, _ = jax.lax.scan(rep, init_of(slabs), None, length=reps)
+        return out
+
+    return chained
+
+
 class ErasureCodeBench:
     """Benchmark driver (ceph_erasure_code_benchmark.cc -> ErasureCodeBench)."""
 
@@ -99,6 +143,18 @@ class ErasureCodeBench:
                              "resident layout, SURVEY §7; same bytes, "
                              "zero repacking inside the chain; w=8 "
                              "matrix codes only)")
+        ap.add_argument("--chain", default="carry",
+                        choices=["carry", "slice"],
+                        help="--loop chain linkage: 'carry' XOR-folds "
+                             "each step's full output into the scan "
+                             "carry (adds 3 output-sized HBM streams "
+                             "per step — the conservative pre-r05 "
+                             "shape); 'slice' carries one element per "
+                             "step, so the chain's HBM traffic is "
+                             "exactly the op's own read+write (the "
+                             "roofline-honest number; the Pallas call "
+                             "is opaque to XLA DCE, so every step "
+                             "still runs in full — tools/roofline.py)")
         ap.add_argument("--json", action="store_true", dest="json_out")
         ap.add_argument("--dump-perf", action="store_true",
                         help="print the perf-counter registry (perf "
@@ -205,21 +261,14 @@ class ErasureCodeBench:
                 slabs = gen(staged)
                 np.asarray(slabs.ravel()[:4])  # materialize
 
-                @jax.jit
-                def chained(slabs):
-                    def step(carry, slab):
-                        return carry ^ encode_step(slab), None
+                m_ = ec.get_coding_chunk_count()
 
-                    m_ = ec.get_coding_chunk_count()
-                    init = jnp.zeros((slabs.shape[1], m_)
+                def full_init(slabs):
+                    return jnp.zeros((slabs.shape[1], m_)
                                      + slabs.shape[3:], slabs.dtype)
 
-                    def rep(carry, _):
-                        c, _ = jax.lax.scan(step, carry, slabs)
-                        return c, None
-
-                    out, _ = jax.lax.scan(rep, init, None, length=reps)
-                    return out
+                chained = build_chain(encode_step, a.chain, packed,
+                                      full_init, reps)
 
                 out = chained(slabs)  # compile/warmup
                 np.asarray(out.ravel()[:4])
@@ -350,27 +399,19 @@ class ErasureCodeBench:
             slabs = gen(staged)
             np.asarray(slabs.ravel()[:4])  # materialize
 
-            @jax.jit
-            def chained(slabs):
-                def step(carry, slab):
-                    out = decode_step(slab, available, pat)
-                    return carry ^ out, None
-
-                init = jnp.zeros((allchunks.shape[0], len(pat))
+            def full_init(slabs):
+                return jnp.zeros((allchunks.shape[0], len(pat))
                                  + slabs.shape[3:], slabs.dtype)
 
-                def rep(carry, _):
-                    c, _ = jax.lax.scan(step, carry, slabs)
-                    return c, None
-
-                out, _ = jax.lax.scan(rep, init, None, length=reps)
-                return out
+            chained = build_chain(
+                lambda slab: decode_step(slab, available, pat),
+                a.chain, packed, full_init, reps)
 
             out = chained(slabs)
-            np.asarray(out[0, 0, :4])
+            np.asarray(out.ravel()[:4])
             begin = time.perf_counter()
             out = chained(slabs)
-            np.asarray(out[0, 0, :4])
+            np.asarray(out.ravel()[:4])
             elapsed = time.perf_counter() - begin
             total_bytes = data.nbytes * n_slabs * reps
             return self._result("decode", elapsed, total_bytes)
@@ -420,6 +461,8 @@ class ErasureCodeBench:
             "size": self.args.size,
             "device": self.args.device,
             "layout": getattr(self.args, "layout", "bytes"),
+            "chain": getattr(self.args, "chain", "carry"),
+            "loop": getattr(self.args, "loop", 0),
             "gbps": gbps,
         }
 

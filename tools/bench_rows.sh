@@ -44,6 +44,12 @@ run_row "north star encode, packed resident layout" \
     -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
     -s $((1<<20)) --batch 64 --loop 1024 --layout packed --json
 
+run_row "north star encode, packed, slice chain (roofline-honest)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<20)) --batch 64 --loop 1024 --layout packed \
+    --chain slice --json
+
 run_row "row 3: shec k=6 m=3 c=2 single-chunk decode" \
     python -m ceph_tpu.bench.erasure_code_benchmark \
     -p shec -P k=6 -P m=3 -P c=2 -s $((6*131072)) \
