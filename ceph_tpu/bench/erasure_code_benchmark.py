@@ -175,6 +175,23 @@ class ErasureCodeBench:
 
     # -- helpers ------------------------------------------------------------
 
+    def _check_slice_chain(self, packed: bool) -> None:
+        """--chain slice is only honest when the chained step is a
+        Pallas call (opaque to XLA DCE): the packed layout on a TPU
+        backend.  Anywhere else XLA narrows the op to the one sliced
+        element and the printed GB/s is fiction — fail loudly instead
+        (found in review: shec/clay decode and CPU runs silently
+        inflated)."""
+        if self.args.chain != "slice":
+            return
+        from ceph_tpu.ops.pallas_gf import use_pallas
+        if not (packed and use_pallas()):
+            raise SystemExit(
+                "--chain slice requires --layout packed on a TPU "
+                "backend (the Pallas step is opaque to XLA DCE); this "
+                "config would lower to pure XLA and report a "
+                "DCE-inflated number — use --chain carry")
+
     def _check_packed(self, ec) -> None:
         """--layout packed needs the w=8 matrix-code packed methods
         (techniques.MatrixCodeMixin); fail as a clean CLI error before
@@ -245,6 +262,7 @@ class ErasureCodeBench:
                 n_slabs = min(a.loop, 16)
                 reps = -(-a.loop // n_slabs)
                 packed = a.layout == "packed"
+                self._check_slice_chain(packed)
                 if packed:
                     self._check_packed(ec)
                     from ceph_tpu.ops.pallas_gf import pack_chunks
@@ -383,6 +401,7 @@ class ErasureCodeBench:
             reps = -(-a.loop // n_slabs)
             avail_idx = np.array(available)
             packed = a.layout == "packed"
+            self._check_slice_chain(packed)
             if packed:
                 self._check_packed(ec)
                 from ceph_tpu.ops.pallas_gf import pack_chunks

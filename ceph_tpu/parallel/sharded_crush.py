@@ -43,7 +43,13 @@ def sharded_bulk_do_rule(mesh: Mesh, cmap, ruleno: int, xs,
         weight = cm.cmap.device_weights()
     tries = (bulk_tries if bulk_tries
              else bulk.auto_tries(cm.cmap, ruleno, result_max))
-    fn = bulk.compile_rule(cm, ruleno, result_max, tries)
+    # leaf_fix_iters=16 selects the convergent while_loop fixpoint for
+    # chooseleaf-indep leaf rejections (r05): without it, every
+    # reweight-rejected leaf try would flag need_host and serialize the
+    # sharded sweep through the host mapper.  On clean maps the loop
+    # body never executes (the pre-loop pass already converged).
+    fn = bulk.compile_rule(cm, ruleno, result_max, tries,
+                           leaf_fix_iters=16)
     n_dev = mesh.shape[axis]
     xs = np.asarray(xs, dtype=np.int64)
     n = len(xs)
