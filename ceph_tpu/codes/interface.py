@@ -74,8 +74,40 @@ class ErasureCodeInterface(abc.ABC):
 
     def minimum_to_decode_with_cost(self, want_to_read: set,
                                     available: Dict[int, int]) -> set:
-        """Given per-chunk costs, pick chunks to read (default: ignore cost)."""
-        return set(self.minimum_to_decode(want_to_read, set(available)).keys())
+        """Pick a decodable read set that avoids high-cost chunks
+        (ErasureCode.cc -> minimum_to_decode_with_cost: the interface
+        exists so ECBackend can route reads away from slow/degraded
+        OSDs).
+
+        Greedy over the plugin's OWN minimum_to_decode: starting from
+        the cost-blind minimum, walk available chunks from costliest
+        down and drop each one whose removal both keeps
+        ``want_to_read`` decodable AND strictly lowers the TOTAL cost
+        of the resulting read set — so the answer is never worse than
+        the cost-blind choice (dropping a pricey wanted chunk is
+        accepted only when reconstructing it from cheap peers is
+        genuinely cheaper, not whenever it is merely possible).  Using
+        minimum_to_decode as the feasibility oracle makes the default
+        correct for every code family — MDS (any k suffice), shec/lrc
+        (locality-constrained recovery sets), clay (sub-chunk repair)
+        — without per-plugin overrides.  Equal costs short-circuit to
+        the cost-blind minimum.  Raises IOError (via
+        minimum_to_decode) when undecodable."""
+        avail = set(available)
+        best = set(self.minimum_to_decode(want_to_read, avail))
+        if len(set(available.values())) <= 1:
+            return best             # flat costs: nothing to trade off
+        best_cost = sum(available[c] for c in best)
+        for c in sorted(avail, key=lambda c: (-available[c], -c)):
+            trial = avail - {c}
+            try:
+                mini = set(self.minimum_to_decode(want_to_read, trial))
+            except (IOError, ValueError):
+                continue            # c is load-bearing; keep it
+            cost = sum(available[x] for x in mini)
+            if cost < best_cost:
+                avail, best, best_cost = trial, mini, cost
+        return best
 
     @abc.abstractmethod
     def encode(self, want_to_encode: set, data: bytes) -> Dict[int, bytes]:

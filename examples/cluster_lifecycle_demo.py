@@ -72,13 +72,27 @@ def main() -> int:
     ]
     for inc in backlog:
         apply_incremental(m, inc)
-    catch_up(observer, [backlog[2], backlog[0], backlog[1]])  # disordered
+    # the observer catches up from DISK: each delta round-trips through
+    # the incremental wire form (OSDMap::Incremental::encode/decode
+    # analog) before applying — the full "resume" story
+    import tempfile
+    from pathlib import Path
+
+    from ceph_tpu.crush.inc_binary import (decode_incremental,
+                                           encode_incremental)
+    with tempfile.TemporaryDirectory() as d:
+        for inc in backlog:
+            Path(d, f"inc.{inc.epoch}").write_bytes(
+                encode_incremental(inc))
+        from_disk = [decode_incremental(Path(d, f"inc.{e}").read_bytes())
+                     for e in (3, 1, 2)]                    # disordered
+    catch_up(observer, from_disk)
     up_m, _ = m.pg_to_up_bulk(1, engine="host")
     up_o, _ = observer.pg_to_up_bulk(1, engine="host")
     assert np.array_equal(up_m, up_o) and m.epoch == observer.epoch == 3
     degraded = int((up_m == CRUSH_ITEM_NONE).sum())
-    print(f"   epoch {m.epoch}: observer converged; osd.7 out, "
-          f"{degraded} unfilled slots cluster-wide")
+    print(f"   epoch {m.epoch}: observer converged from on-disk deltas; "
+          f"osd.7 out, {degraded} unfilled slots cluster-wide")
 
     print("== 4. balancer -> pg-upmap-items as an incremental ==")
     counts = m.pg_counts_per_osd(1, engine="host")

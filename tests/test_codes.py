@@ -232,3 +232,57 @@ def test_decode_concat():
     del encoded[1], encoded[2]
     out = ec.decode_concat(encoded)
     assert out[:200] == data
+
+
+def test_minimum_to_decode_with_cost():
+    """ErasureCode.cc -> minimum_to_decode_with_cost: route reads away
+    from high-cost chunks while staying decodable; equal costs must
+    reproduce the cost-blind minimum exactly."""
+    ec = registry().factory("jerasure",
+                            {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    flat = {c: 1 for c in range(6)}
+    # equal costs == the cost-blind preference (first-k / wanted-only)
+    assert ec.minimum_to_decode_with_cost({0, 1}, flat) == {0, 1}
+    assert ec.minimum_to_decode_with_cost(
+        {0, 1, 2, 3}, {c: 1 for c in range(1, 6)}) == {1, 2, 3, 4}
+    # chunk 2 is WANTED but sits on a slow OSD: reconstructing it from
+    # four cheap chunks beats reading it (MDS: any k decode everything)
+    costs = {c: 1 for c in range(6)}
+    costs[2] = 100
+    assert ec.minimum_to_decode_with_cost({0, 1, 2, 3}, costs) \
+        == {0, 1, 3, 4}
+    # wanting only surviving chunks: the expensive one is avoided
+    costs = {1: 1, 2: 100, 3: 1, 4: 1, 5: 1}
+    got = ec.minimum_to_decode_with_cost({0}, costs)
+    assert 2 not in got and len(got) == 4
+    # undecodable still raises
+    with pytest.raises(IOError):
+        ec.minimum_to_decode_with_cost({0}, {1: 1, 2: 1, 3: 1})
+    # a marginally pricier wanted chunk must NOT trigger full-k
+    # reconstruction: total cost of reading {0} (4) beats rebuilding
+    # it from four cost-3 peers (12) — found in review
+    costs = {0: 4, 1: 3, 2: 3, 3: 3, 4: 3, 5: 3}
+    assert ec.minimum_to_decode_with_cost({0}, costs) == {0}
+
+
+def test_minimum_to_decode_with_cost_shec_locality():
+    """shec: the greedy must respect the code's own recovery-set
+    feasibility (not every k-subset decodes a non-MDS code)."""
+    ec = registry().factory("shec", {"k": "6", "m": "3", "c": "2"})
+    n = ec.get_chunk_count()
+    costs = {c: 1 for c in range(1, n)}     # chunk 0 erased
+    base = set(ec.minimum_to_decode({0}, set(range(1, n))))
+    assert ec.minimum_to_decode_with_cost({0}, costs) == base
+    # make one member of the min-read set expensive: the result must
+    # still decode (pin by actually reconstructing chunk 0)
+    pick = max(base)
+    costs[pick] = 50
+    got = ec.minimum_to_decode_with_cost({0}, costs)
+    data = bytes(range(251)) * 6
+    enc = ec.encode(set(range(n)), data)
+    sub = {c: enc[c] for c in got}
+    dec = ec.decode({0}, sub, len(enc[0]))
+    assert dec[0] == enc[0]
+    # and the total cost is no worse than the cost-blind choice
+    assert (sum(costs[c] for c in got)
+            <= sum(costs[c] for c in base))
