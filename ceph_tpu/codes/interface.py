@@ -81,12 +81,17 @@ class ErasureCodeInterface(abc.ABC):
 
         Greedy over the plugin's OWN minimum_to_decode: starting from
         the cost-blind minimum, walk available chunks from costliest
-        down and drop each one whose removal both keeps
-        ``want_to_read`` decodable AND strictly lowers the TOTAL cost
-        of the resulting read set — so the answer is never worse than
-        the cost-blind choice (dropping a pricey wanted chunk is
-        accepted only when reconstructing it from cheap peers is
-        genuinely cheaper, not whenever it is merely possible).  Using
+        down and drop each one whose removal keeps ``want_to_read``
+        decodable without RAISING the total cost of the resulting read
+        set — so the answer is never worse than the cost-blind choice
+        (dropping a pricey wanted chunk pays off only when
+        reconstructing it from cheap peers is genuinely no costlier,
+        not whenever it is merely possible).  Equal-cost drops are
+        accepted so a SECOND expensive chunk cannot mask a win: with
+        two slow OSDs, dropping the first is cost-neutral and dropping
+        the second then exposes the cheap reconstruction (found in
+        review; the costliest-first order resolves any such chain in
+        one pass).  Using
         minimum_to_decode as the feasibility oracle makes the default
         correct for every code family — MDS (any k suffice), shec/lrc
         (locality-constrained recovery sets), clay (sub-chunk repair)
@@ -105,7 +110,7 @@ class ErasureCodeInterface(abc.ABC):
             except (IOError, ValueError):
                 continue            # c is load-bearing; keep it
             cost = sum(available[x] for x in mini)
-            if cost < best_cost:
+            if cost <= best_cost:
                 avail, best, best_cost = trial, mini, cost
         return best
 

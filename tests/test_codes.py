@@ -263,6 +263,12 @@ def test_minimum_to_decode_with_cost():
     # it from four cost-3 peers (12) — found in review
     costs = {0: 4, 1: 3, 2: 3, 3: 3, 4: 3, 5: 3}
     assert ec.minimum_to_decode_with_cost({0}, costs) == {0}
+    # ...but TWO slow OSDs must not mask the win: the cost-blind
+    # oracle re-picks slow chunk 1 after dropping 0, which the
+    # single-improvement greedy stalled on (found in review) — the
+    # equal-cost drop of 1 first exposes the cheap reconstruction
+    costs = {0: 100, 1: 100, 2: 1, 3: 1, 4: 1, 5: 1}
+    assert ec.minimum_to_decode_with_cost({0}, costs) == {2, 3, 4, 5}
 
 
 def test_minimum_to_decode_with_cost_shec_locality():
