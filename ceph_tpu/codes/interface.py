@@ -99,10 +99,11 @@ class ErasureCodeInterface(abc.ABC):
         the cost-blind minimum.  Raises IOError (via
         minimum_to_decode) when undecodable."""
         avail = set(available)
-        best = set(self.minimum_to_decode(want_to_read, avail))
+        blind = set(self.minimum_to_decode(want_to_read, avail))
         if len(set(available.values())) <= 1:
-            return best             # flat costs: nothing to trade off
-        best_cost = sum(available[c] for c in best)
+            return blind            # flat costs: nothing to trade off
+        blind_cost = sum(available[c] for c in blind)
+        best, best_cost = blind, blind_cost
         for c in sorted(avail, key=lambda c: (-available[c], -c)):
             trial = avail - {c}
             try:
@@ -112,7 +113,11 @@ class ErasureCodeInterface(abc.ABC):
             cost = sum(available[x] for x in mini)
             if cost <= best_cost:
                 avail, best, best_cost = trial, mini, cost
-        return best
+        # equal-cost drops above are PROVISIONAL (they unmask chained
+        # wins); if no strict improvement materialized, the cost-blind
+        # set wins — a cost-neutral k-chunk reconstruction must never
+        # replace a direct read (review: 4x read amplification)
+        return best if best_cost < blind_cost else blind
 
     @abc.abstractmethod
     def encode(self, want_to_encode: set, data: bytes) -> Dict[int, bytes]:

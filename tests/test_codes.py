@@ -269,6 +269,11 @@ def test_minimum_to_decode_with_cost():
     # equal-cost drop of 1 first exposes the cheap reconstruction
     costs = {0: 100, 1: 100, 2: 1, 3: 1, 4: 1, 5: 1}
     assert ec.minimum_to_decode_with_cost({0}, costs) == {2, 3, 4, 5}
+    # a COST-NEUTRAL reconstruction must never replace the direct
+    # read: rebuilding from four cost-1 peers ties reading chunk 0
+    # (4 == 4), and the tie goes to 1 read, not 4 (found in review)
+    costs = {0: 4, 1: 1, 2: 1, 3: 1, 4: 1, 5: 1}
+    assert ec.minimum_to_decode_with_cost({0}, costs) == {0}
 
 
 def test_minimum_to_decode_with_cost_shec_locality():

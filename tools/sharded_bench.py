@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -50,7 +51,6 @@ def child(n: int) -> dict:
     devs = np.array(jax.devices()[:n])
 
     from ceph_tpu.crush import CrushBuilder
-    from ceph_tpu.crush.builder import CrushBuilder as _CB  # noqa: F401
     from ceph_tpu.matrices.jerasure import (
         reed_sol_vandermonde_coding_matrix)
     from ceph_tpu.parallel.sharded_codes import sharded_encode
@@ -107,7 +107,6 @@ def main() -> int:
         env.pop("PALLAS_AXON_POOL_IPS", None)   # never dial the tunnel
         env["JAX_PLATFORMS"] = "cpu"
         flags = env.get("XLA_FLAGS", "")
-        import re
         flag = f"--xla_force_host_platform_device_count={n}"
         if "xla_force_host_platform_device_count" in flags:
             flags = re.sub(
@@ -115,9 +114,18 @@ def main() -> int:
         else:
             flags = f"{flags} {flag}".strip()
         env["XLA_FLAGS"] = flags
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child", str(n)],
-            capture_output=True, text=True, env=env, timeout=1200)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child", str(n)],
+                capture_output=True, text=True, env=env, timeout=1200)
+        except subprocess.TimeoutExpired:
+            # a wedged child (XLA compile stall on an odd host) must
+            # not abort the sweep: same error-row-and-continue path as
+            # a nonzero exit
+            print(json.dumps({"n_devices": n,
+                              "error": ["timeout after 1200s"]}))
+            continue
         if r.returncode != 0:
             print(json.dumps({"n_devices": n, "error":
                               r.stderr.strip().splitlines()[-1:]}))
@@ -125,11 +133,15 @@ def main() -> int:
         row = json.loads(r.stdout.strip().splitlines()[-1])
         rows.append(row)
         print(json.dumps(row))
-    if rows:
+    if len(rows) > 1:
         base = rows[0]
         summary = {
             "metric": "sharded_scaling",
             "physical_cores": os.cpu_count(),
+            # explicit baseline: a failed N=1 child must not silently
+            # rebaseline the "speedup" to the next device count
+            "baseline_devices": base["n_devices"],
+            "max_devices": rows[-1]["n_devices"],
             "crush_speedup_at_max": round(
                 rows[-1]["crush_mappings_per_s"]
                 / base["crush_mappings_per_s"], 2),
