@@ -78,6 +78,11 @@ NONE = CRUSH_ITEM_NONE
 # rules auto-scale it (_auto_tries).
 DEFAULT_BULK_TRIES = 8
 
+# device budget for the chooseleaf leaf-retry ladders; deeper
+# SET_CHOOSELEAF_TRIES values model the first 8 attempts and flag the
+# (vanishingly rare) lane whose accepted candidate exhausts them
+LEAF_TRIES_CAP = 8
+
 # lanes per device dispatch (bulk_do_rule blocks larger sweeps)
 BULK_BLOCK = 1 << 18
 
@@ -473,92 +478,159 @@ def _is_out(weight_vec, item, x):
     return ~(in_range & keep)
 
 
-def _candidates(cm, take, x, rs, type_, recurse_to_leaf, weight_vec,
-                take_type, pos=0):
-    """All candidate picks for an attempt grid ``rs`` in two batched
-    descents: the heavy hash work for every (rep, try) is one fused
-    computation; only the cheap accept logic stays sequential.
-    ``pos``: choose_args position grid (mapper.c outpos; see callers).
-    Returns (items, leaves, ok_domain, ok_full): ok_domain is
-    acceptability BEFORE the leaf recursion (needed by the
-    leaf-retry host-fallback flag, see compile_rule)."""
-    items, ok_dom = _descend(cm, take, x, rs, type_,
-                             cm.descend_steps(take_type, type_), pos)
-    if recurse_to_leaf:
-        # stable=1 -> recursion rep 0; vary_r=1 -> sub_r = r >> 0
-        leaves, lok = _descend(cm, items, x, rs, 0,
-                               cm.descend_steps(type_, 0), pos)
-        lout = _is_out(weight_vec, leaves, x)
-        ok = ok_dom & lok & ~lout
-    else:
-        leaves = items
-        ok = ok_dom
-        if type_ == 0:
-            # device reject -> next domain try (exact at one leaf try)
-            ok = ok & ~_is_out(weight_vec, items, x)
-            ok_dom = ok
-    return items, leaves, ok_dom, ok
-
-
 def _choose_firstn(cm, take, x, numrep, type_, recurse_to_leaf,
-                   weight_vec, T, take_type, leaf_retry=False):
-    """mapper.c -> crush_choose_firstn, attempt-batched.
+                   weight_vec, T, take_type, leaf_tries=1,
+                   leaf_cap=LEAF_TRIES_CAP, leaf_fix_iters=1,
+                   exact_budget=False):
+    """mapper.c -> crush_choose_firstn, attempt-batched and leaf-lazy.
 
-    Candidate (rep, try) descents are mutually independent (r = rep +
-    ftotal depends only on indices), so the whole (numrep, T) grid is
-    two batched descents; the sequential part is only the collision /
-    first-acceptable scan — identical to the C retry ladder under jewel
-    tunables (no local retries).  Returns (out, count, need_host).
+    The (numrep, T) domain candidate grid is one batched descent (r =
+    rep + ftotal depends only on indices); the sequential part is the
+    collision / first-acceptable scan per rep — identical to the C
+    retry ladder under jewel tunables (no local retries).  Leaf
+    recursions run ONLY for each rep's accepted candidate: C's
+    recursion is numrep=1/stable with r' = sub_r + ftotal' (sub_r = r,
+    vary_r=1; no uniform stride in firstn), up to ``leaf_tries``
+    attempts, each rejected when out-weighted OR colliding with an
+    EARLIER position's leaf (the out2[0..outpos) scan — unlike indep,
+    firstn dedups leaves across positions, so leaf resolution stays
+    inside the sequential rep loop).  A candidate whose ladder is
+    dead for prefix-INDEPENDENT reasons is marked bad at its
+    (rep, try) position and the scan re-runs — _choose_indep's
+    fixpoint, restricted per the soundness note inside (collision-
+    caused ladder failures depend on the provisional prefix and flag
+    need_host instead of marking; marking also requires the modeled
+    ladder to cover C's full leaf budget).  Returns
+    (out, count, need_host).
 
-    ``leaf_retry``: the rule SET choose_leaf_tries > 1, so C may
-    salvage a domain candidate whose first leaf pick failed by
-    retrying the recursion; the device models one leaf try, so any
-    lane where a leaf-failed domain candidate precedes the accepted
-    one re-runs on the exact host mapper."""
+    ``exact_budget``: an unfilled rep at the rule's own budget is C's
+    own short result (the packing matches: C skips the rep without
+    advancing outpos) — valid ONLY with single-position choose_args,
+    because C hashes later picks with outpos (= placed count), which
+    diverges from our static rep-indexed position grid once a rep
+    fails."""
     rs = (jnp.arange(numrep, dtype=jnp.int64)[:, None]
           + jnp.arange(T, dtype=jnp.int64)[None, :])        # (R, T)
-    # choose_args position = outpos at bucket-choose time; bulk keeps
-    # only lanes where every rep places (a failed rep flags need_host),
-    # so outpos == rep for both the domain pick and the leaf recursion
-    # (firstn recursion passes the parent outpos through)
+    # choose_args position = outpos at bucket-choose time; every lane
+    # the device keeps has all reps placed (see exact_budget note), so
+    # outpos == rep for both the domain pick and the leaf recursion
     pos = jnp.arange(numrep)[:, None]                       # (R, 1)
-    items, leaves, okd0, ok0 = _candidates(cm, take, x, rs, type_,
-                                           recurse_to_leaf, weight_vec,
-                                           take_type, pos)
-    out = jnp.full(numrep, NONE, jnp.int32)
-    out2 = jnp.full(numrep, NONE, jnp.int32)
-    placed_n = jnp.int32(0)
-    need_host = jnp.asarray(False)
-    for rep in range(numrep):
-        cand, leaf_cand = items[rep], leaves[rep]            # (T,)
-        collide = jnp.any(out[None, :] == cand[:, None], axis=1)
-        ok = ok0[rep] & ~collide
-        if recurse_to_leaf:
-            lcollide = jnp.any(out2[None, :] == leaf_cand[:, None],
+    exact_budget = exact_budget and cm.n_positions == 1
+    items, okd = _descend(cm, take, x, rs, type_,
+                          cm.descend_steps(take_type, type_), pos)
+    if not recurse_to_leaf and type_ == 0:
+        okd = okd & ~_is_out(weight_vec, items, x)
+
+    if not recurse_to_leaf:
+        out = jnp.full(numrep, NONE, jnp.int32)
+        placed_n = jnp.int32(0)
+        need_host = jnp.asarray(False)
+        for rep in range(numrep):
+            cand = items[rep]                                # (T,)
+            collide = jnp.any(out[None, :] == cand[:, None], axis=1)
+            ok = okd[rep] & ~collide
+            first = jnp.argmax(ok)
+            any_ok = jnp.any(ok)
+            slot = jnp.arange(numrep) == placed_n
+            out = jnp.where(slot & any_ok, cand[first], out)
+            placed_n = placed_n + any_ok.astype(jnp.int32)
+            if not exact_budget:
+                need_host = need_host | ~any_ok
+        return out, placed_n, need_host
+
+    L = max(1, min(leaf_tries, LEAF_TRIES_CAP, leaf_cap))
+    sound = L == leaf_tries
+    fix = max(1, leaf_fix_iters) if sound else 1
+    ls = jnp.arange(L, dtype=jnp.int64)
+    leaf_steps = cm.descend_steps(type_, 0)
+
+    def accept_pass(bad):
+        out = jnp.full(numrep, NONE, jnp.int32)
+        out2 = jnp.full(numrep, NONE, jnp.int32)
+        placed_n = jnp.int32(0)
+        fail_pure = jnp.zeros(numrep, bool)
+        coll_fail = jnp.zeros(numrep, bool)
+        firsts = jnp.zeros(numrep, jnp.int32)
+        unfilled = jnp.zeros(numrep, bool)
+        for rep in range(numrep):
+            cand = items[rep]                                # (T,)
+            collide = jnp.any(out[None, :] == cand[:, None], axis=1)
+            ok = okd[rep] & ~collide & ~bad[rep]
+            first = jnp.argmax(ok)
+            any_ok = jnp.any(ok)
+            sel_item = cand[first]
+            sub_r = rs[rep, first]                           # vary_r=1
+            start = jnp.where(any_ok, sel_item, jnp.int32(-1))
+            leaves_l, lok_l = _descend(
+                cm, jnp.broadcast_to(start, (L,)), x, sub_r + ls, 0,
+                leaf_steps, rep)
+            # leaf_ok_pure is a pure function of (rep, try) — the
+            # ONLY basis for fixpoint marks (see below); lcollide
+            # depends on earlier positions' provisional leaves and
+            # may only influence this pass's pick, never a mark
+            leaf_ok_pure = lok_l & ~_is_out(weight_vec, leaves_l, x)
+            lcollide = jnp.any(out2[None, :] == leaves_l[:, None],
                                axis=1)
-            ok = ok & ~lcollide
-        first = jnp.argmax(ok)
-        any_ok = jnp.any(ok)
-        if leaf_retry and recurse_to_leaf:
-            # a domain-acceptable candidate with a failed leaf at or
-            # before the accepted position: C's leaf retries could
-            # have chosen it instead
-            dok = okd0[rep] & ~collide
-            before = jnp.arange(T) < jnp.where(any_ok, first, T)
-            need_host = need_host | jnp.any(dok & ~ok & before)
-        slot = jnp.arange(numrep) == placed_n
-        out = jnp.where(slot & any_ok, cand[first], out)
-        out2 = jnp.where(slot & any_ok, leaf_cand[first], out2)
-        placed_n = placed_n + any_ok.astype(jnp.int32)
-        # C would keep trying up to choose_total_tries: flag for host
-        need_host = need_host | ~any_ok
-    return (out2 if recurse_to_leaf else out), placed_n, need_host
+            leaf_ok = leaf_ok_pure & ~lcollide
+            lfirst = jnp.argmax(leaf_ok)
+            lany = jnp.any(leaf_ok)
+            lany_pure = jnp.any(leaf_ok_pure)
+            placed = any_ok & lany
+            slot = jnp.arange(numrep) == placed_n
+            out = jnp.where(slot & placed, sel_item, out)
+            out2 = jnp.where(slot & placed, leaves_l[lfirst], out2)
+            placed_n = placed_n + placed.astype(jnp.int32)
+            reparr = jnp.arange(numrep) == rep
+            fail_pure = jnp.where(reparr, any_ok & ~lany_pure,
+                                  fail_pure)
+            coll_fail = jnp.where(reparr, any_ok & lany_pure & ~lany,
+                                  coll_fail)
+            firsts = jnp.where(reparr, first.astype(jnp.int32), firsts)
+            unfilled = jnp.where(reparr, ~any_ok, unfilled)
+        return out, out2, placed_n, fail_pure, coll_fail, firsts, \
+            unfilled
 
+    # Fixpoint soundness for firstn (review finding): a candidate may
+    # fail its ladder for two reasons — every attempt dead
+    # (out-weighted / no leaf), which is prefix-INDEPENDENT and safe
+    # to mark bad (C rejects it against any prefix), or attempts
+    # alive but colliding with EARLIER positions' leaves, which
+    # depends on the pass's provisional prefix and must NOT be marked
+    # (C might accept it against the final prefix).  Marks therefore
+    # come only from fail_pure; a collision-caused failure surviving
+    # to the final pass flags need_host (requires a dual-homed device
+    # — two domain buckets sharing an osd — which real maps don't
+    # produce).  On convergence the returned pass's prefix IS final,
+    # so its lcollide masks are exact.
+    bad = jnp.zeros((numrep, T), bool)
+    cols = jnp.arange(T, dtype=jnp.int32)[None, :]
+    out, out2, placed_n, fail_pure, coll_fail, firsts, unfilled = \
+        accept_pass(bad)
+    if fix > 8:
+        def cond(st):
+            return jnp.any(st[0][3]) & (st[1] < numrep * T + 1)
 
-# device budget for the chooseleaf-indep leaf-retry ladder; deeper
-# SET_CHOOSELEAF_TRIES values model the first 8 attempts and flag the
-# (vanishingly rare) lane whose accepted candidate exhausts them
-LEAF_TRIES_CAP = 8
+        def body(st):
+            res, it, bad = st
+            fail_pure, firsts = res[3], res[5]
+            bad = bad | ((cols == firsts[:, None]) & fail_pure[:, None])
+            return accept_pass(bad), it + 1, bad
+
+        (out, out2, placed_n, fail_pure, coll_fail, firsts,
+         unfilled), _, bad = jax.lax.while_loop(
+            cond, body,
+            ((out, out2, placed_n, fail_pure, coll_fail, firsts,
+              unfilled), jnp.int32(0), bad))
+    else:
+        for _ in range(fix - 1):
+            bad = bad | ((cols == firsts[:, None])
+                         & fail_pure[:, None])
+            out, out2, placed_n, fail_pure, coll_fail, firsts, \
+                unfilled = accept_pass(bad)
+    need_host = jnp.any(fail_pure) | jnp.any(coll_fail)
+    if not exact_budget:
+        need_host = need_host | jnp.any(unfilled)
+    return out2, placed_n, need_host
 
 
 def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
@@ -729,19 +801,25 @@ def _choose_indep(cm, take, x, numrep, type_, recurse_to_leaf,
 
 def _chained_single(cm, takes, count, x, type_, recurse_to_leaf,
                     weight_vec, T, firstn, from_type,
-                    leaf_retry=False):
+                    leaf_tries=1, leaf_cap=LEAF_TRIES_CAP,
+                    leaf_fix_iters=1, exact_budget=False):
     """A SECOND choose step over the previous step's output vector
     (mapper.c: per input bucket a fresh segment, outpos=0), numrep=1
     per segment — the common chained EC shape (choose N type rack ->
     chooseleaf 1 type host).
 
-    Candidates for every (try, segment) pair come from two batched
-    descents (segments are independent: r restarts per segment and
-    numrep=1 segments cannot self-collide); per segment the first
-    acceptable try wins.  firstn semantics: a segment that places
-    nothing (or an invalid take inside the segment range) shifts
-    downstream packing in mapper.c, so those lanes re-run on the host;
-    indep leaves a NONE hole in place."""
+    Domain candidates for every (try, segment) pair come from one
+    batched descent (segments are independent: r restarts per segment,
+    numrep=1 segments cannot self-collide, and C's chained recursion
+    collision scans are empty at outpos=0); per segment the first
+    acceptable try wins, with leaf recursions modeled lazily for the
+    accepted candidate only — the same leaf-ladder + mark-bad fixpoint
+    as _choose_indep (see its docstring for the soundness argument),
+    simplified by segment independence.  firstn semantics: a segment
+    that places nothing (or an invalid take inside the segment range)
+    shifts downstream packing in mapper.c, so those lanes re-run on
+    the host; an indep hole at the rule's own full budget
+    (``exact_budget``) is C's NONE and stays on device."""
     R = takes.shape[0]
     # firstn at numrep=1: r = rep+parent_r+ftotal = ftotal.  indep at
     # numrep=1: r = rep + stride*ftotal with the per-level uniform
@@ -759,37 +837,98 @@ def _chained_single(cm, takes, count, x, type_, recurse_to_leaf,
             indep_numrep=1, return_last_r=True)
     in_seg = jnp.arange(R) < count
     valid_take = takes < 0
+    live = in_seg & valid_take
     # an invalid take inside the segment range is skipped entirely by
     # mapper.c (osize does not advance) — positions shift: host lane
     need_host = jnp.any(in_seg & ~valid_take)
-    if recurse_to_leaf:
-        # jewel semantics: recursion rep 0, one leaf try; firstn:
-        # sub_r = r (vary_r=1); indep: parent_r = the final pick's r
-        leaf_r = fs if firstn else parent_r
-        leaves, lok = _descend(cm, items, x, leaf_r, 0,
-                               cm.descend_steps(type_, 0), 0)
-        lout = _is_out(weight_vec, leaves, x)
-        ok_dom = ok
-        ok = ok & lok & ~lout
-        if leaf_retry:
-            # C's leaf retries could salvage a leaf-failed candidate
-            need_host = need_host | jnp.any(
-                ok_dom & ~ok & (in_seg & valid_take)[None, :])
-    else:
-        leaves = items
+    if not recurse_to_leaf:
         if type_ == 0:
             ok = ok & ~_is_out(weight_vec, items, x)
-    ok = ok & (in_seg & valid_take)[None, :]
-    first = jnp.argmax(ok, axis=0)                       # (R,)
-    any_ok = jnp.any(ok, axis=0)
-    pick = leaves if recurse_to_leaf else items
-    sel = jnp.take_along_axis(pick, first[None, :], axis=0)[0]
-    out = jnp.where(any_ok, sel, NONE).astype(jnp.int32)
-    # a segment that exhausted the device try budget may still place
-    # within C's choose_total_tries: host fallback decides (for firstn
-    # the failure also shifts packing; for indep the hole may be a
-    # budget artifact — same conservative flag as _choose_indep)
-    need_host = need_host | jnp.any(in_seg & valid_take & ~any_ok)
+        ok = ok & live[None, :]
+        first = jnp.argmax(ok, axis=0)                   # (R,)
+        any_ok = jnp.any(ok, axis=0)
+        sel = jnp.take_along_axis(items, first[None, :], axis=0)[0]
+        out = jnp.where(any_ok, sel, NONE).astype(jnp.int32)
+        # an unfilled segment may still place within C's own budget —
+        # host decides — unless T already IS that budget, where a
+        # firstn miss still shifts packing (host) but an indep hole
+        # is C's own NONE
+        if firstn or not exact_budget:
+            need_host = need_host | jnp.any(live & ~any_ok)
+        return out, need_host
+
+    # chooseleaf: leaf ladders ONLY for each segment's accepted
+    # candidate, modeling C's recursion exactly — firstn: numrep=1
+    # stable recursion, r' = sub_r + l with sub_r = r (vary_r=1), no
+    # uniform stride; indep: r' = parent_r + stride*l via the
+    # per-level indep stride at numrep=1.  Same provisional-accept +
+    # mark-bad fixpoint as _choose_indep, but segments are independent
+    # (no cross-segment collision scans in C), so the fixpoint is
+    # per-segment.
+    ok_dom = ok & live[None, :]
+    L = max(1, min(leaf_tries, LEAF_TRIES_CAP, leaf_cap))
+    sound = L == leaf_tries
+    fix = max(1, leaf_fix_iters) if sound else 1
+    ls = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int64)[:, None], (L, R))
+    leaf_steps = cm.descend_steps(type_, 0)
+    rows = jnp.arange(T, dtype=jnp.int32)[:, None]
+
+    def accept(bad):
+        okb = ok_dom & ~bad
+        return jnp.argmax(okb, axis=0).astype(jnp.int32), \
+            jnp.any(okb, axis=0)
+
+    def leaf_eval(first, any_ok):
+        sel_item = jnp.take_along_axis(items, first[None, :], axis=0)[0]
+        sel_r = jnp.take_along_axis(parent_r, first[None, :].astype(
+            jnp.int64), axis=0)[0]
+        start = jnp.where(any_ok, sel_item, jnp.int32(-1))[None, :]
+        if firstn:
+            leaves, lok = _descend(cm, start, x, sel_r + ls, 0,
+                                   leaf_steps, 0)
+        else:
+            leaves, lok = _descend(cm, start, x,
+                                   jnp.broadcast_to(sel_r, (L, R)), 0,
+                                   leaf_steps, 0, indep_f=ls,
+                                   indep_numrep=1)
+        leaf_ok = lok & ~_is_out(weight_vec, leaves, x)    # (L, R)
+        lfirst = jnp.argmax(leaf_ok, axis=0)
+        lany = jnp.any(leaf_ok, axis=0)
+        leaf_sel = jnp.take_along_axis(leaves, lfirst[None, :],
+                                       axis=0)[0]
+        return leaf_sel, lany
+
+    bad = jnp.zeros((T, R), bool)
+    first, any_ok = accept(bad)
+    leaf_sel, lany = leaf_eval(first, any_ok)
+    if fix > 8:
+        def cond(st):
+            bad, first, any_ok, leaf_sel, lany, it = st
+            return jnp.any(any_ok & ~lany) & (it < T + 1)
+
+        def body(st):
+            bad, first, any_ok, leaf_sel, lany, it = st
+            fail = any_ok & ~lany
+            bad = bad | ((rows == first[None, :]) & fail[None, :])
+            first, any_ok = accept(bad)
+            leaf_sel, lany = leaf_eval(first, any_ok)
+            return (bad, first, any_ok, leaf_sel, lany, it + 1)
+
+        bad, first, any_ok, leaf_sel, lany, _ = jax.lax.while_loop(
+            cond, body,
+            (bad, first, any_ok, leaf_sel, lany, jnp.int32(0)))
+    else:
+        for _ in range(fix - 1):
+            fail = any_ok & ~lany
+            bad = bad | ((rows == first[None, :]) & fail[None, :])
+            first, any_ok = accept(bad)
+            leaf_sel, lany = leaf_eval(first, any_ok)
+    fail = any_ok & ~lany
+    placed = any_ok & lany
+    out = jnp.where(placed, leaf_sel, NONE).astype(jnp.int32)
+    need_host = need_host | jnp.any(fail)
+    if firstn or not exact_budget:
+        need_host = need_host | jnp.any(live & ~any_ok)
     return out, need_host
 
 
@@ -834,14 +973,13 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
         # values are trace-time constants.  choose_tries caps the
         # per-step device budget (a SET below T must not let the
         # device succeed where C's budget ran out); choose_leaf_tries
-        # > 1 turns on the leaf-retry host-fallback flag (the device
-        # models one leaf try; lanes C could salvage re-run exactly
-        # on the host).
+        # feeds the per-candidate leaf-retry ladders (capped at the
+        # rung's leaf_cap; a candidate exhausting the modeled ladder
+        # is marked bad / flagged per the fixpoint soundness rule).
         choose_tries_run = tunables.choose_total_tries + 1
         leaf_tries_run = 0   # 0 = descend_once default (one try)
         for op, arg1, arg2 in steps:
             T_step = max(1, min(T, choose_tries_run))
-            leaf_retry = leaf_tries_run > 1
             if op == CRUSH_RULE_TAKE:
                 take = arg1
                 current = None
@@ -881,7 +1019,10 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
                     vals, nh = _chained_single(
                         cm, current[0], current[1], x, arg2, recurse,
                         weight_vec, T_step, True, current_type,
-                        leaf_retry=leaf_retry)
+                        leaf_tries=leaf_tries_run if leaf_tries_run
+                        else 1, leaf_cap=leaf_cap,
+                        leaf_fix_iters=leaf_fix_iters,
+                        exact_budget=T_step >= choose_tries_run)
                     need_host = need_host | nh
                     current = (vals, current[1])
                     current_type = arg2
@@ -892,7 +1033,10 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
                              if take in cm.cmap.buckets else None)
                 vals, count, nh = _choose_firstn(
                     cm, take, x, numrep, arg2, recurse, weight_vec,
-                    T_step, take_type, leaf_retry=leaf_retry)
+                    T_step, take_type,
+                    leaf_tries=leaf_tries_run if leaf_tries_run else 1,
+                    leaf_cap=leaf_cap, leaf_fix_iters=leaf_fix_iters,
+                    exact_budget=T_step >= choose_tries_run)
                 need_host = need_host | nh
                 current = (vals, count)
                 current_type = arg2
@@ -908,7 +1052,10 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
                     vals, nh = _chained_single(
                         cm, current[0], current[1], x, arg2, recurse,
                         weight_vec, T_step, False, current_type,
-                        leaf_retry=leaf_retry)
+                        leaf_tries=leaf_tries_run if leaf_tries_run
+                        else 1, leaf_cap=leaf_cap,
+                        leaf_fix_iters=leaf_fix_iters,
+                        exact_budget=T_step >= choose_tries_run)
                     need_host = need_host | nh
                     current = (vals, current[1])
                     current_type = arg2
@@ -995,13 +1142,15 @@ def auto_ladder(cmap, ruleno: int, result_max: int,
     width = rule_width(cmap, ruleno, result_max)
     cap = _rule_tries_cap(cmap, ruleno)
     first = FIRST_PASS_TRIES if width <= 4 else width + 2
-    cl_indep = any(op == CRUSH_RULE_CHOOSELEAF_INDEP
-                   for op, _, _ in cmap.rules[ruleno].steps)
-    # (leaf_cap, fix_iters) shape only the chooseleaf-indep program;
-    # for every other rule they are normalized to (CAP, 1) so rungs
+    # (leaf_cap, fix_iters) shape the leaf-lazy chooseleaf programs —
+    # firstn, indep, and both chained forms; for rules with NO
+    # chooseleaf step they are normalized to (CAP, 1) so rungs
     # differing only in them would compile identical HLO under a new
     # cache key — those duplicates are dropped below
-    if cl_indep:
+    leaf_lazy = any(op in (CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                           CRUSH_RULE_CHOOSELEAF_INDEP)
+                    for op, _, _ in cmap.rules[ruleno].steps)
+    if leaf_lazy:
         cands = ((first, 1, 1),
                  (first, LEAF_TRIES_CAP, 2),
                  (bulk_tries, LEAF_TRIES_CAP, 4),

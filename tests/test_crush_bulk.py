@@ -656,3 +656,35 @@ def test_bulk_ec_rule_adversarial_reweights_bounded_fallback():
         ref = ref + [CRUSH_ITEM_NONE] * (6 - len(ref))
         assert list(out[x]) == ref, (x, ref, list(out[x]))
 
+
+
+@pytest.mark.slow
+def test_bulk_dual_homed_reweighted_chooseleaf():
+    """Dual-homed device + reweights + set_chooseleaf_tries: leaf
+    ladders can fail through COLLISIONS with earlier positions'
+    leaves, a prefix-dependent failure the firstn fixpoint must route
+    to the host rather than mark bad (review soundness finding)."""
+    from ceph_tpu.crush import CrushBuilder
+    from ceph_tpu.crush.types import (step_set_choose_tries,
+                                      step_set_chooseleaf_tries)
+    b = CrushBuilder()
+    b.add_type(1, "host")
+    b.add_type(2, "root")
+    h1 = b.add_bucket("straw2", "host", [0, 1, 7])
+    h2 = b.add_bucket("straw2", "host", [2, 3, 7])
+    h3 = b.add_bucket("straw2", "host", [4, 5])
+    h4 = b.add_bucket("straw2", "host", [6, 8])
+    root = b.add_bucket("straw2", "root", [h1, h2, h3, h4])
+    b.add_rule(0, [step_set_chooseleaf_tries(5),
+                   step_set_choose_tries(50), step_take(root),
+                   step_chooseleaf_firstn(0, 1), step_emit()])
+    b.add_rule(1, [step_set_chooseleaf_tries(5),
+                   step_set_choose_tries(50), step_take(root),
+                   step_chooseleaf_indep(0, 1), step_emit()])
+    w = [0x10000] * b.map.max_devices
+    w[0] = 0
+    w[2] = 0x3000
+    w[4] = 0
+    w[7] = 0x8000
+    pin(b, 0, 3, N=500, weight=w)
+    pin(b, 1, 3, N=500, weight=w)
