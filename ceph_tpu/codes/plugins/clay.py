@@ -613,26 +613,31 @@ class ErasureCodeClay(ErasureCode):
         """(batch, k, chunk) uint8 device array -> (batch, m, chunk) parity
         on device: ONE sparse composite-matrix application (the probed
         matrix has ~k*2^t nonzeros per row, not k*sub — the layered
-        structure survives composition)."""
-        from ...ops.xla_ops import apply_matrix_xla
+        structure survives composition).  apply_matrix_best routes the
+        composite (m*sub x k*sub >= thousands of entries) to the MXU
+        bit-sliced matmul on TPU; the unrolled schedule elsewhere."""
+        from ...ops.pallas_gf import apply_matrix_best
         M = self._probe_encode_matrix()
         ms = self._static(("encode_static",), M)
         b, k, chunk = data.shape
         sub = self.sub_chunk_no
         x = data.reshape(b, k * sub, chunk // sub)
-        y = apply_matrix_xla(x, ms, W)
+        y = apply_matrix_best(x, ms, W)
         return y.reshape(b, self.m, chunk)
 
     def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
         """(batch, len(available), chunk) device array ->
-        (batch, len(erased), chunk)."""
-        from ...ops.xla_ops import apply_matrix_xla
+        (batch, len(erased), chunk); MXU-routed like encode_chunks_jax
+        (the k=8,m=4,d=11 single-erasure composite is 64x704 — measured
+        3.9 GB/s on chip through the unrolled schedule, the motivating
+        case for apply_matrix_mxu)."""
+        from ...ops.pallas_gf import apply_matrix_best
         M = self._probe_decode_matrix(tuple(available), tuple(erased))
         ms = self._static(("decode_static", available, erased), M)
         b, na, chunk = chunks.shape
         sub = self.sub_chunk_no
         x = chunks.reshape(b, na * sub, chunk // sub)
-        y = apply_matrix_xla(x, ms, W)
+        y = apply_matrix_best(x, ms, W)
         return y.reshape(b, len(erased), chunk)
 
     # -- probed composite matrices (TPU batch path) -------------------------

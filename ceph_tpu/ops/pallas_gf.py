@@ -429,15 +429,29 @@ def use_pallas() -> bool:
     return _device_kind() == "tpu"
 
 
+# entry count above which a GF(2^8) matrix routes to the MXU matmul
+# path on TPU: the unrolled xtime/XOR schedule (VPU) wins for small
+# coding matrices (RS k=8,m=3 = 24 entries), while composite matrices
+# (clay's 64x704 single-erasure decode) explode its op count and HBM
+# traffic; the bit-sliced GF(2) matmul turns them into one MXU
+# contraction (ops/xla_ops.py -> apply_matrix_mxu)
+MXU_MATRIX_MIN = 2048
+
+
 def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
     """Dispatch over the engines, byte-identical in every branch
     (cross-pinned in tests):
 
+    - w=8, LARGE matrix (>= MXU_MATRIX_MIN entries) on TPU: the
+      bit-sliced GF(2) matmul on the MXU (clay composites).
     - w=8, uint8 in: the byte Pallas kernel on TPU, XLA otherwise.
     - w=16/32, word-typed in (uint16/uint32 views — what the plugin
       mixins pass): the word Pallas kernel on TPU, XLA otherwise.
     """
-    from .xla_ops import apply_matrix_xla
+    from .xla_ops import apply_matrix_mxu, apply_matrix_xla
+    if (w == 8 and chunks.dtype == jnp.uint8 and use_pallas()
+            and len(matrix_t) * len(matrix_t[0]) >= MXU_MATRIX_MIN):
+        return apply_matrix_mxu(chunks, matrix_t)
     if (w == 8 and chunks.dtype == jnp.uint8 and use_pallas()
             and pallas_matrix_supported(chunks.shape, w)):
         return apply_matrix_pallas(chunks, matrix_t)

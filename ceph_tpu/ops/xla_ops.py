@@ -213,3 +213,46 @@ def encode_bitmatrix_xla(data: jax.Array, bitmatrix, w: int,
                          packetsize: int) -> jax.Array:
     return apply_bitmatrix_xla(data, bitmatrix_to_static(bitmatrix), w,
                                packetsize)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def apply_matrix_mxu(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
+    """LARGE-matrix GF(2^8) apply as a bit-sliced GF(2) matmul — the
+    MXU path (SURVEY's "matmuls are where the FLOPs are").
+
+    The unrolled xtime/XOR schedule (apply_matrix_xla and the Pallas
+    kernel) is right for small coding matrices (RS k=8,m=3 is 24
+    entries) but explodes for the composite matrices clay's layered
+    structure produces (k=8,m=4,d=11 single-erasure decode is a 64x704
+    GF(2^8) matrix: thousands of materialized doubling planes, ~250x
+    HBM traffic amplification, 3.9 GB/s measured on chip).  Here the
+    apply becomes ONE matmul: over GF(2) the matrix is the (r*8, s*8)
+    bitmatrix B (gf/bitmatrix.py: block (i,j) column x = bits of
+    M[i,j]*2^x), the data becomes LSB-first bit-planes, and
+    out = parity(B @ X) rides the systolic array.  Exactness: 0/1
+    operands are exact in bf16 and dot accumulates in f32
+    (preferred_element_type), sums <= s*8 < 2^24 — pinned bit-for-bit
+    against apply_matrix_xla / the host ground truth in
+    tests/test_mxu.py.  w=8 only."""
+    from ..gf.bitmatrix import matrix_to_bitmatrix
+
+    assert w == 8 and chunks.dtype == jnp.uint8
+    r = len(matrix_t)
+    s = len(matrix_t[0])
+    assert chunks.shape[-2] == s
+    # f32 accumulation is exact only while partial sums stay integral:
+    # loudly refuse a matrix wide enough to overflow the 2^24 mantissa
+    # rather than silently round parity bits
+    assert s * 8 < (1 << 24), f"matrix too wide for exact f32 dot: {s}"
+    lead = chunks.shape[:-2]
+    c = chunks.shape[-1]
+    B = matrix_to_bitmatrix(s, r, 8, [list(row) for row in matrix_t])
+    Bj = jnp.asarray(B, jnp.bfloat16)                  # (r*8, s*8)
+    planes = jnp.arange(8, dtype=jnp.uint8)
+    bits = (chunks[..., :, None, :] >> planes[:, None]) & 1
+    x = bits.reshape(lead + (s * 8, c)).astype(jnp.bfloat16)
+    y = jnp.einsum("ij,...jc->...ic", Bj, x,
+                   preferred_element_type=jnp.float32)
+    par = (y.astype(jnp.int32) & 1).astype(jnp.uint8)
+    pb = par.reshape(lead + (r, 8, c))
+    return jnp.sum(pb << planes[:, None], axis=-2).astype(jnp.uint8)

@@ -1,0 +1,82 @@
+"""apply_matrix_mxu — the bit-sliced GF(2) matmul path for LARGE
+GF(2^8) matrices (ops/xla_ops.py), pinned bit-for-bit against the
+unrolled-schedule XLA path and the numpy host ground truth, including
+clay's real composite decode matrix (the motivating 64x704 case).
+
+The MXU path is plain XLA (einsum with f32 accumulation over bf16 0/1
+operands), so exactness is testable on CPU; on TPU the same program
+rides the systolic array (apply_matrix_best routes matrices >=
+MXU_MATRIX_MIN entries there)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import regionops
+from ceph_tpu.ops.xla_ops import (apply_matrix_mxu, apply_matrix_xla,
+                                  matrix_to_static)
+
+
+@pytest.mark.parametrize("r,s,c,seed", [
+    (3, 8, 256, 1),          # RS-sized (below the dispatch threshold,
+                             # but the math must agree at any size)
+    (16, 48, 128, 2),        # mid-size composite
+    pytest.param(64, 176, 512, 3, marks=pytest.mark.slow),
+    # ^ clay-shaped slice: the comparison side compiles the unrolled
+    #   schedule for a dense >11k-entry matrix (~1 min) — slow split
+])
+def test_mxu_matches_schedule_and_host(r, s, c, seed):
+    rng = np.random.default_rng(seed)
+    M = rng.integers(0, 256, (r, s), dtype=np.int64)
+    M[rng.random((r, s)) < 0.7] = 0          # composite-like sparsity
+    ms = matrix_to_static(M)
+    data = rng.integers(0, 256, (2, s, c), dtype=np.uint8)
+    got = np.asarray(apply_matrix_mxu(data, ms, 8))
+    want_xla = np.asarray(apply_matrix_xla(data, ms, 8))
+    assert np.array_equal(got, want_xla)
+    want_host = regionops.matrix_encode(data[0], M, 8)
+    assert np.array_equal(got[0], want_host)
+
+
+@pytest.mark.slow
+def test_mxu_matches_clay_composite():
+    """The real clay k=8,m=4,d=11 single-erasure composite decode
+    matrix through both engines, and the decoded bytes must equal the
+    erased chunk."""
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "clay", {"k": "8", "m": "4", "d": "11"})
+    n = ec.get_chunk_count()
+    sub = ec.get_sub_chunk_count()
+    avail = tuple(range(1, n))
+    M = ec._probe_decode_matrix(avail, (0,))
+    ms = matrix_to_static(M)
+    assert M.shape[0] * M.shape[1] >= 2048   # really a big matrix
+    rng = np.random.default_rng(7)
+    chunk = sub * 64
+    data = rng.integers(0, 256, (2, ec.k, chunk), dtype=np.uint8)
+    import jax.numpy as jnp
+    parity = np.asarray(ec.encode_chunks_jax(jnp.asarray(data)))
+    allc = np.concatenate([data, parity], axis=1)
+    x = allc[:, list(avail)].reshape(2, (n - 1) * sub, chunk // sub)
+    got = np.asarray(apply_matrix_mxu(x, ms, 8)).reshape(2, 1, chunk)
+    want = np.asarray(apply_matrix_xla(x, ms, 8)).reshape(2, 1, chunk)
+    assert np.array_equal(got, want)
+    assert np.array_equal(got[:, 0], allc[:, 0])   # actually repairs
+
+
+@pytest.mark.slow
+def test_mxu_dispatch_threshold():
+    """apply_matrix_best only reroutes big matrices on TPU backends;
+    on CPU every size stays on the XLA schedule path (which this
+    asserts indirectly: outputs identical either way)."""
+    from ceph_tpu.ops.pallas_gf import MXU_MATRIX_MIN, apply_matrix_best
+
+    rng = np.random.default_rng(11)
+    r, s = 8, MXU_MATRIX_MIN // 8 + 1
+    M = rng.integers(0, 256, (r, s), dtype=np.int64)
+    ms = matrix_to_static(M)
+    data = rng.integers(0, 256, (1, s, 64), dtype=np.uint8)
+    a = np.asarray(apply_matrix_best(data, ms, 8))
+    b = np.asarray(apply_matrix_mxu(data, ms, 8))
+    assert np.array_equal(a, b)
