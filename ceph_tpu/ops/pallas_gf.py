@@ -429,13 +429,18 @@ def use_pallas() -> bool:
     return _device_kind() == "tpu"
 
 
-# entry count above which a GF(2^8) matrix routes to the MXU matmul
-# path on TPU: the unrolled xtime/XOR schedule (VPU) wins for small
-# coding matrices (RS k=8,m=3 = 24 entries), while composite matrices
-# (clay's 64x704 single-erasure decode) explode its op count and HBM
-# traffic; the bit-sliced GF(2) matmul turns them into one MXU
+# NONZERO-entry count above which a GF(2^8) matrix routes to the MXU
+# matmul path on TPU: the unrolled xtime/XOR schedule's op count and
+# HBM traffic scale with set bits, not dimensions (XLA dead-code
+# eliminates planes no entry uses), so a huge-but-nearly-empty matrix
+# stays on the near-memcpy schedule while composite matrices (clay's
+# 64x704 single-erasure decode, ~2.2k nonzeros) become one MXU
 # contraction (ops/xla_ops.py -> apply_matrix_mxu)
 MXU_MATRIX_MIN = 2048
+
+
+def _matrix_nnz(matrix_t) -> int:
+    return sum(1 for row in matrix_t for v in row if v)
 
 
 def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
@@ -448,10 +453,13 @@ def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
     - w=16/32, word-typed in (uint16/uint32 views — what the plugin
       mixins pass): the word Pallas kernel on TPU, XLA otherwise.
     """
-    from .xla_ops import apply_matrix_mxu, apply_matrix_xla
+    from . import xla_ops
+    from .xla_ops import apply_matrix_xla
     if (w == 8 and chunks.dtype == jnp.uint8 and use_pallas()
-            and len(matrix_t) * len(matrix_t[0]) >= MXU_MATRIX_MIN):
-        return apply_matrix_mxu(chunks, matrix_t)
+            and _matrix_nnz(matrix_t) >= MXU_MATRIX_MIN):
+        # module attribute (not a local import) so the routing test
+        # can observe which engine was selected
+        return xla_ops.apply_matrix_mxu(chunks, matrix_t)
     if (w == 8 and chunks.dtype == jnp.uint8 and use_pallas()
             and pallas_matrix_supported(chunks.shape, w)):
         return apply_matrix_pallas(chunks, matrix_t)
