@@ -191,9 +191,17 @@ class ErasureCodeShec(MatrixCodeMixin, ErasureCode):
         return self._apply(stack, dm, dm_static)
 
     def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
-        """Device-resident decode (bench path): plan once, one XLA apply."""
-        from ...ops.xla_ops import apply_matrix_xla, matrix_to_static
-        from ...ops.xla_ops import jax_bytes_view, jax_words_view
+        """Device-resident decode (bench path): plan once, one apply.
+
+        apply_matrix_best, not the raw XLA path: the XLA w=8 SWAR
+        branch bitcasts u8<->u32 in HBM, which is a full relayout on
+        TPU (u8 tiles (32,128) vs u32 (8,128)) costing ~3x the math —
+        the Pallas byte kernel packs in-registers instead (the same
+        lesson the encode path learned in round 3; this was the shec
+        decode row's 17 GB/s bottleneck)."""
+        from ...ops.pallas_gf import apply_matrix_best
+        from ...ops.xla_ops import (jax_bytes_view, jax_words_view,
+                                    matrix_to_static)
         plan = self.tcache.get_plan(self.matrix, self.k, self.w,
                                     frozenset(available), frozenset(erased))
         aidx = {c: t for t, c in enumerate(available)}
@@ -201,7 +209,8 @@ class ErasureCodeShec(MatrixCodeMixin, ErasureCode):
         worder = {c: t for t, c in enumerate(plan.want_order)}
         sub = chunks[:, np.array(sel), :]
         words = jax_words_view(sub, self.w)
-        out = apply_matrix_xla(words, matrix_to_static(plan.matrix), self.w)
+        out = apply_matrix_best(words, matrix_to_static(plan.matrix),
+                                self.w)
         out = jax_bytes_view(out)
         keep = np.array([worder[c] for c in erased])
         return out[:, keep, :]

@@ -439,7 +439,11 @@ def use_pallas() -> bool:
 MXU_MATRIX_MIN = 2048
 
 
+@functools.lru_cache(maxsize=256)
 def _matrix_nnz(matrix_t) -> int:
+    # cached: matrix_t is the hashable static tuple, and this runs in
+    # the per-call dispatch path (45k Python iterations for a clay
+    # composite would otherwise tax every apply)
     return sum(1 for row in matrix_t for v in row if v)
 
 

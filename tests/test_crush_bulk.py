@@ -654,10 +654,12 @@ def test_bulk_ec_rule_adversarial_reweights_bounded_fallback():
     assert nf / len(xs) < 0.001, f"host fallback {nf}/{len(xs)}"
     # 2x the clean sweep plus the deep rungs' fixed cost (residue
     # batches are padded to pow2 blocks, which doesn't scale with N:
-    # at 100k lanes the measured ratio is ~2.1x, at 20k the constant
-    # dominates — and it absorbs full-suite scheduling noise, which
-    # tipped a 4.0 s allowance in the round-5 gate run)
-    assert d_adv < 2 * d_clean + 12.0, (d_adv, d_clean)
+    # at 100k lanes the measured ratio is ~2.1x; at 20k the padded
+    # rungs are ~3.5 s of REAL fixed work, so 4.0 s was inherently
+    # marginal and tipped in the round-5 gate run).  The serialization
+    # regression this guards against is caught primarily by the
+    # fallback-fraction assert above; the timer is a coarse backstop.
+    assert d_adv < 2 * d_clean + 8.0, (d_adv, d_clean)
     for x in rng.choice(len(xs), 120, replace=False):
         ref = crush_do_rule(b.map, 0, int(x), 6, weight=w)
         ref = ref + [CRUSH_ITEM_NONE] * (6 - len(ref))
