@@ -1,0 +1,42 @@
+"""tpu-lint — AST static analysis enforcing the repo's device invariants.
+
+The runtime sanitizer (utils/debug.py, CEPH_TPU_VERIFY) catches a bad
+byte after it is computed; this package catches the code *shapes* that
+produce bad bytes or silent recompiles before anything runs — the
+compile-time face of the reference's WITH_ASAN/UBSAN + clang-tidy QA
+gate:
+
+- dtype discipline: GF(2^8) symbol paths (gf/, ops/, codes/, matrices/)
+  must stay integer — float intermediates round parity bits.
+- host-sync hazards: np.* / .item() / int() on traced values inside a
+  jitted or Pallas function block the pipeline per call.
+- recompilation traps: unhashable static_argnums payloads, jitted
+  closures over mutable state, Python branches on tracer values.
+- purity: RNG / clocks / I/O / global mutation inside jitted code bakes
+  trace-time values into the compiled program.
+- GF arithmetic misuse: Python *, %, ** on GF table values computes
+  integer math where field math is required.
+
+Run ``python tools/tpu_lint.py [--json] [paths...]`` or use
+:func:`lint_paths`; suppress a deliberate pattern with
+``# tpu-lint: disable=<rule> -- reason``.  docs/LINT.md documents every
+rule and the relationship to the runtime sanitizer.
+"""
+
+from .config import LintConfig
+from .rules import ALL_RULES, Finding, Rule
+from .scanner import FileReport, LintReport, lint_file, lint_paths
+from .report import render_human, render_json
+
+__all__ = [
+    "ALL_RULES",
+    "FileReport",
+    "Finding",
+    "LintConfig",
+    "LintReport",
+    "Rule",
+    "lint_file",
+    "lint_paths",
+    "render_human",
+    "render_json",
+]

@@ -1,0 +1,49 @@
+"""Report rendering: human-readable (one finding per line, grep-able
+``path:line:col: [rule] message``) and JSON (stable schema for CI
+tooling)."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from .rules import ALL_RULES
+from .scanner import LintReport
+
+
+def render_human(report: LintReport, show_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    for fr in report.files:
+        for f in fr.findings:
+            lines.append(f.render())
+        if show_suppressed:
+            for f in fr.suppressed:
+                reason = f" ({f.suppress_reason})" if f.suppress_reason \
+                    else ""
+                lines.append(f"{f.render()} [suppressed{reason}]")
+    n_files = len(report.files)
+    n = len(report.findings)
+    ns = len(report.suppressed)
+    lines.append(
+        f"tpu-lint: {n} finding{'s' if n != 1 else ''} "
+        f"({ns} suppressed) in {n_files} file"
+        f"{'s' if n_files != 1 else ''}")
+    return "\n".join(lines)
+
+
+def render_json(report: LintReport) -> str:
+    payload = {
+        "files": len(report.files),
+        "findings": [f.as_dict() for f in report.findings],
+        "suppressed": [f.as_dict() for f in report.suppressed],
+        "ok": report.ok,
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+def render_rules() -> str:
+    lines = []
+    for rule in ALL_RULES:
+        lines.append(f"{rule.id} [{rule.category}]")
+        lines.append(f"    {rule.description}")
+    return "\n".join(lines)

@@ -21,6 +21,8 @@ and cross-checked in tests.
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 from ...gf.bitmatrix import matrix_to_bitmatrix
@@ -45,7 +47,7 @@ SIZEOF_INT = 4
 
 def _is_prime(n: int) -> bool:
     """ErasureCodeJerasure.cc -> is_prime (table up to 257 upstream)."""
-    return n >= 2 and all(n % p for p in range(2, int(n ** 0.5) + 1))
+    return n >= 2 and all(n % p for p in range(2, math.isqrt(n) + 1))
 
 
 class ErasureCodeJerasure(ErasureCode):

@@ -962,6 +962,7 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
     T = min(bulk_tries, _rule_tries_cap(cm.cmap, ruleno))
     steps = list(rule.steps)
 
+    # tpu-lint: jit-function
     def fn(x, weight_vec):
         results = []
         take = None

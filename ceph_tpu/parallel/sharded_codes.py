@@ -22,6 +22,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map landed in 0.6; on older releases it lives in
+# jax.experimental with check_rep instead of check_vma — same knob
+# (skip the replication static analysis), renamed upstream.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SM_NOCHECK = {"check_vma": False}
+else:  # pragma: no cover - version-dependent branch
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SM_NOCHECK = {"check_rep": False}
+
 from ..matrices.jerasure import reed_sol_vandermonde_coding_matrix
 from ..ops.xla_ops import apply_matrix_xla, matrix_to_static
 
@@ -59,13 +69,13 @@ def _sharded_encode_fn(mesh: Mesh, matrix_key: tuple):
             acc = acc ^ parts[t]
         return acc
 
-    # check_vma=False: the XOR of all_gather'ed partials IS replicated
-    # across "chunk", but the static analysis can't see through the
-    # axis_index-driven lax.switch that picked the matrix slice.
-    return jax.jit(jax.shard_map(
+    # no replication check: the XOR of all_gather'ed partials IS
+    # replicated across "chunk", but the static analysis can't see
+    # through the axis_index-driven lax.switch that picked the slice.
+    return jax.jit(_shard_map(
         step, mesh=mesh,
         in_specs=P("stripe", "chunk", None),
-        out_specs=P("stripe", None, None), check_vma=False))
+        out_specs=P("stripe", None, None), **_SM_NOCHECK))
 
 
 def sharded_encode(mesh: Mesh, data, matrix: np.ndarray):

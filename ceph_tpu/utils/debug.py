@@ -25,7 +25,16 @@ import contextlib
 import os
 import threading
 
+# nesting counters; ALL mutation happens under _ACTIVE_LOCK.  The nan
+# config is process-global jax state, so it is refcounted the same way:
+# the first enabler saves the original value, the last one restores it.
+# (The previous save/restore-per-context scheme raced under the
+# test_threading.py workload: an outer thread exiting first restored
+# the original value while another thread's debug block was still
+# active, silently disabling its NaN checking.)
 _ACTIVE = 0
+_NAN_ACTIVE = 0
+_NAN_PREV = None
 _ACTIVE_LOCK = threading.Lock()
 
 
@@ -34,24 +43,33 @@ class DeviceVerificationError(AssertionError):
 
 
 def verification_enabled() -> bool:
+    # unlocked read: an int compare on a counter only ever mutated
+    # under the lock — worst case is the same transient answer a
+    # locked read could return
     return _ACTIVE > 0 or os.environ.get("CEPH_TPU_VERIFY") == "1"
 
 
 @contextlib.contextmanager
 def debug_mode(nan_checks: bool = True):
     """Enable sanitizer-equivalent checking for the enclosed block."""
-    global _ACTIVE
+    global _ACTIVE, _NAN_ACTIVE, _NAN_PREV
     import jax
-    prev_nan = None
-    if nan_checks:
-        prev_nan = jax.config.read("jax_debug_nans")
-        jax.config.update("jax_debug_nans", True)
     with _ACTIVE_LOCK:
         _ACTIVE += 1
+        if nan_checks:
+            _NAN_ACTIVE += 1
+            if _NAN_ACTIVE == 1:
+                # attribute read, not config.read(): jax raises on
+                # read() for flags that have a contextmanager
+                _NAN_PREV = jax.config.jax_debug_nans
+                jax.config.update("jax_debug_nans", True)
     try:
         yield
     finally:
         with _ACTIVE_LOCK:
             _ACTIVE -= 1
-        if nan_checks and prev_nan is not None:
-            jax.config.update("jax_debug_nans", prev_nan)
+            if nan_checks:
+                _NAN_ACTIVE -= 1
+                if _NAN_ACTIVE == 0:
+                    jax.config.update("jax_debug_nans", _NAN_PREV)
+                    _NAN_PREV = None

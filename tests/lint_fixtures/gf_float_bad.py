@@ -1,0 +1,11 @@
+# tpu-lint: scope=gf
+"""Red fixture: every statement here violates gf-float."""
+import numpy as np
+
+
+def bad_scale(region):
+    half = region / 2                        # true division
+    f = region.astype(np.float32)            # float astype
+    z = np.zeros(8, dtype=np.float64)        # float dtype kw
+    w = float(region[0])                     # float() conversion
+    return half, f, z, w, 0.5                # float literal

@@ -1,0 +1,10 @@
+# tpu-lint: scope=gf
+"""Green fixture: integer GF code, nothing to flag."""
+import numpy as np
+
+
+def good_scale(region):
+    half = region >> 1
+    q = region // 2
+    z = np.zeros(8, dtype=np.uint8)
+    return half ^ q ^ z

@@ -1,0 +1,10 @@
+# tpu-lint: scope=gf
+"""Suppressed fixture: the same hazards, each carrying a pragma."""
+import numpy as np
+
+
+def tolerated(region):
+    # tpu-lint: disable=gf-float -- fixture: deliberate float ladder
+    half = region / 2
+    f = region.astype(np.float32)  # tpu-lint: disable=gf-float -- fixture
+    return half, f

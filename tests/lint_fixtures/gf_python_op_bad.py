@@ -1,0 +1,12 @@
+# tpu-lint: scope=gf
+"""Red fixture: Python integer arithmetic on GF table values."""
+from ceph_tpu.gf.gf8 import gf8
+
+
+def bad_products(a, b):
+    g = gf8()
+    p = g.exp[a] * g.exp[b]          # integer * on antilog values
+    q = g.mul_table[a][b] ** 2       # integer pow on a field product
+    r = g.log[a] % 7                 # non-255 modulus on log values
+    s = pow(g.inv_table[a], 3)       # pow() on a table value
+    return p, q, r, s

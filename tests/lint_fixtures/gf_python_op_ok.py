@@ -1,0 +1,14 @@
+# tpu-lint: scope=gf
+"""Green fixture: field math through gf_mul and the log-domain idioms."""
+import numpy as np
+
+from ceph_tpu.gf.gf8 import gf8, gf_mul, gf_pow
+
+
+def good_products(a, b):
+    g = gf8()
+    p = gf_mul(a, b)
+    q = gf_pow(a, 2)
+    r = g.exp[(g.log[a] + g.log[b]) % 255]   # log-domain wrap is exempt
+    m = (np.eye(4, dtype=np.int64) @ np.eye(4, dtype=np.int64)) % 2
+    return p, q, r, m

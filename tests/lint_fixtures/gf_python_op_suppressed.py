@@ -1,0 +1,11 @@
+# tpu-lint: scope=gf
+"""Suppressed fixture for gf-python-op."""
+from ceph_tpu.gf.gf8 import gf8
+
+
+def tolerated(a, b):
+    g = gf8()
+    # tpu-lint: disable=gf-python-op -- fixture: integer weighting on
+    # purpose (not field math)
+    p = g.exp[a] * 3
+    return p

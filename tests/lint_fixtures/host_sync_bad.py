@@ -1,0 +1,21 @@
+"""Red fixture: host syncs on traced values inside jit regions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def syncing(x):
+    a = np.asarray(x)            # device->host transfer
+    n = int(x.sum())             # concretizes the tracer
+    i = x.max().item()           # blocks on device compute
+    return a, n, i
+
+
+def helper(v):
+    return np.array(v)           # host sync via propagation
+
+
+@jax.jit
+def entry(q):
+    return helper(q * 2)

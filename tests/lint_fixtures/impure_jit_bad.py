@@ -1,0 +1,17 @@
+"""Red fixture: impurity inside jit regions."""
+import time
+
+import jax
+import numpy as np
+
+_CACHE = {}
+
+
+@jax.jit
+def impure(x):
+    t0 = time.perf_counter()            # clock at trace time
+    noise = np.random.default_rng(0).integers(0, 9)  # trace-time RNG
+    print("tracing", t0)                # trace-time output
+    global _CACHE                       # global mutation
+    _CACHE = {"x": 1}
+    return x + noise

@@ -1,0 +1,19 @@
+"""Green fixture: pure jit code — explicit PRNG keys, debug.print,
+clocks outside the region."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure(x, key):
+    noise = jax.random.randint(key, x.shape, 0, 9, dtype=jnp.uint8)
+    jax.debug.print("per-call value {v}", v=x[0])
+    return x ^ noise
+
+
+def bench(x, key):
+    t0 = time.perf_counter()        # host side: fine
+    out = pure(x, key)
+    return out, time.perf_counter() - t0

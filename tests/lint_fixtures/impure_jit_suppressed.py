@@ -1,0 +1,9 @@
+"""Suppressed fixture for impure-jit."""
+import jax
+
+
+@jax.jit
+def traced_log(x):
+    # tpu-lint: disable=impure-jit -- fixture: trace-marker on purpose
+    print("tracing once per compile is intended here")
+    return x

@@ -1,0 +1,14 @@
+"""Red fixture: jitted closure over state the scope keeps mutating."""
+import jax
+import jax.numpy as jnp
+
+
+def factory():
+    scale = jnp.ones(4)
+
+    @jax.jit
+    def apply(x):
+        return x * scale          # captures scale at trace time
+
+    scale = scale * 2             # mutation after the trace capture
+    return apply

@@ -1,0 +1,19 @@
+"""Green fixture: closures over write-once bindings (the compile-once
+factory pattern) and state passed as arguments."""
+import jax
+import jax.numpy as jnp
+
+
+def factory(matrix_t):
+    k = len(matrix_t)             # bound once, never reassigned
+
+    @jax.jit
+    def apply(x):
+        return x * k
+
+    return apply
+
+
+@jax.jit
+def explicit(x, scale):
+    return x * scale              # state as an argument: retrace-safe

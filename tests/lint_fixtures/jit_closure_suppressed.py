@@ -1,0 +1,15 @@
+"""Suppressed fixture for jit-closure."""
+import jax
+import jax.numpy as jnp
+
+
+def factory():
+    scale = jnp.ones(4)
+
+    # tpu-lint: disable=jit-closure -- fixture: rebinding is deliberate
+    @jax.jit
+    def apply(x):
+        return x * scale
+
+    scale = scale * 2
+    return apply

@@ -1,0 +1,18 @@
+"""Red fixture: unhashable static_argnums payloads."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def apply(x, matrix):
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def defaulted(x, cfg=[8, 3]):     # mutable default on a static param
+    return x
+
+
+def call_site(data):
+    return apply(data, [[1, 2], [3, 4]])   # list literal -> TypeError

@@ -1,0 +1,14 @@
+"""Green fixture: hashable tuple-of-tuples static payloads
+(the matrix_to_static contract)."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def apply(x, matrix_t, w=8):
+    return x
+
+
+def call_site(data):
+    return apply(data, ((1, 2), (3, 4)), 8)

@@ -1,0 +1,14 @@
+"""Suppressed fixture for static-args."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def apply(x, matrix):
+    return x
+
+
+def call_site(data):
+    # tpu-lint: disable=static-args -- fixture: known one-shot call
+    return apply(data, [[1, 2], [3, 4]])

@@ -1,0 +1,11 @@
+"""Red fixture: Python control flow on traced values."""
+import jax
+
+
+@jax.jit
+def branching(x, y):
+    if x > 0:                 # TracerBoolConversionError at trace
+        y = y + 1
+    while y:                  # same, in a loop head
+        y = y - 1
+    return y

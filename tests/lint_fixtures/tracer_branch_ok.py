@@ -1,0 +1,18 @@
+"""Green fixture: static branching (shapes, dtypes, static args,
+``is None``) plus jnp.where for value-dependent selection."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def shaped(x, w):
+    if w == 8:                          # static arg: trace-time branch
+        x = x ^ jnp.uint8(1)
+    if x.shape[-1] % 4 == 0:            # shapes are static under jit
+        x = x.reshape(x.shape[:-1] + (x.shape[-1] // 4, 4))
+    acc = None
+    for i in range(3):                  # python loop over static range
+        acc = x if acc is None else acc ^ x   # `is` checks are static
+    return jnp.where(acc > 0, acc, -acc)      # traced select, on device

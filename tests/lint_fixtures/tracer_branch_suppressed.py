@@ -1,0 +1,10 @@
+"""Suppressed fixture for tracer-branch."""
+import jax
+
+
+@jax.jit
+def tolerated(x, y):
+    # tpu-lint: disable=tracer-branch -- fixture: documented trap
+    if x > 0:
+        y = y + 1
+    return y

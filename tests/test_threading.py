@@ -77,6 +77,50 @@ def test_concurrent_same_instance_encode():
     assert not errors, errors
 
 
+def test_debug_mode_nesting_is_thread_safe():
+    """utils/debug.py refcounting under concurrent + nested use: after
+    every thread exits, verification must be off and the process-global
+    jax_debug_nans flag restored to its original value (the old
+    save/restore-per-context scheme let the first-exiting thread
+    restore it while another block was still active)."""
+    import jax
+
+    from ceph_tpu.utils import debug
+    from ceph_tpu.utils.debug import debug_mode, verification_enabled
+
+    orig_nan = jax.config.jax_debug_nans
+    errors: list = []
+    barrier = threading.Barrier(8)
+
+    def worker(i):
+        try:
+            barrier.wait(timeout=30)
+            for _ in range(25):
+                with debug_mode():
+                    if not verification_enabled():
+                        errors.append(f"{i}: not enabled inside block")
+                    if not jax.config.jax_debug_nans:
+                        errors.append(f"{i}: nan checking dropped while "
+                                      "a debug block is active")
+                    with debug_mode(nan_checks=False):   # nesting
+                        if not verification_enabled():
+                            errors.append(f"{i}: nested block disabled")
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(f"{i}: {e!r}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errors, errors
+    assert debug._ACTIVE == 0
+    assert debug._NAN_ACTIVE == 0
+    import os
+    if os.environ.get("CEPH_TPU_VERIFY") != "1":
+        assert not verification_enabled()
+    assert jax.config.jax_debug_nans == orig_nan
+
+
 def test_registry_double_add_rejected():
     reg = ErasureCodePluginRegistry.instance()
     from ceph_tpu.codes.registry import ErasureCodePlugin

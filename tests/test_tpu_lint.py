@@ -1,0 +1,210 @@
+"""tpu-lint (ceph_tpu/analysis) — tier-1 gate.
+
+Three layers:
+- the repo itself must be lint-clean (zero unsuppressed findings over
+  ceph_tpu/ and tools/) — the compile-time analog of CEPH_TPU_VERIFY;
+- every rule has red/green/suppressed fixture coverage under
+  tests/lint_fixtures/;
+- injecting a float GF op or a host sync into a jitted path must turn
+  both the library API and the CLI red (the acceptance criterion).
+
+The linter is pure-AST: no jax import, so this file runs in any env.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "lint_fixtures")
+sys.path.insert(0, ROOT)
+
+from ceph_tpu.analysis import LintConfig, lint_file, lint_paths  # noqa: E402
+from ceph_tpu.analysis.rules import ALL_RULES, RULES_BY_ID  # noqa: E402
+from ceph_tpu.analysis.scanner import lint_source  # noqa: E402
+
+RULE_IDS = sorted(r.id for r in ALL_RULES)
+
+
+# ----------------------------------------------------------------------
+# the repo gate
+def test_repo_is_lint_clean():
+    report = lint_paths([os.path.join(ROOT, "ceph_tpu"),
+                         os.path.join(ROOT, "tools")])
+    msgs = "\n".join(f.render() for f in report.findings)
+    assert report.ok, f"unsuppressed tpu-lint findings:\n{msgs}"
+    # the suppressions that do exist must all carry a reason string
+    for f in report.suppressed:
+        assert f.suppress_reason, \
+            f"suppression without reason: {f.render()}"
+
+
+def test_repo_scan_covers_the_package():
+    report = lint_paths([os.path.join(ROOT, "ceph_tpu")])
+    assert len(report.files) > 50  # the whole package parsed
+
+
+# ----------------------------------------------------------------------
+# per-rule fixture battery: red / suppressed / green
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_red_fixture(rule_id):
+    stem = rule_id.replace("-", "_")
+    rep = lint_file(os.path.join(FIXTURES, f"{stem}_bad.py"))
+    hits = [f for f in rep.findings if f.rule == rule_id]
+    assert hits, f"red fixture for {rule_id} produced no findings"
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_suppressed_fixture(rule_id):
+    stem = rule_id.replace("-", "_")
+    rep = lint_file(os.path.join(FIXTURES, f"{stem}_suppressed.py"))
+    live = [f for f in rep.findings if f.rule == rule_id]
+    sup = [f for f in rep.suppressed if f.rule == rule_id]
+    assert not live, [f.render() for f in live]
+    assert sup, f"suppressed fixture for {rule_id} suppressed nothing"
+    assert all(f.suppress_reason for f in sup)
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_green_fixture(rule_id):
+    stem = rule_id.replace("-", "_")
+    rep = lint_file(os.path.join(FIXTURES, f"{stem}_ok.py"))
+    hits = [f.render() for f in rep.findings if f.rule == rule_id]
+    assert not hits, hits
+
+
+def test_every_rule_has_fixture_trio():
+    for rule_id in RULE_IDS:
+        stem = rule_id.replace("-", "_")
+        for suffix in ("bad", "suppressed", "ok"):
+            p = os.path.join(FIXTURES, f"{stem}_{suffix}.py")
+            assert os.path.exists(p), p
+
+
+# ----------------------------------------------------------------------
+# injection: a regression in a jitted GF path goes red end to end
+INJECTED = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def encode(chunks):
+    scaled = chunks.astype(np.float32)    # float GF intermediate
+    host = np.asarray(chunks)             # host sync inside jit
+    return scaled, host
+'''
+
+
+def test_injected_float_gf_op_fails_lint(tmp_path):
+    pkg = tmp_path / "gf"
+    pkg.mkdir()
+    (pkg / "injected.py").write_text(INJECTED)
+    report = lint_paths([str(tmp_path)])
+    rules = {f.rule for f in report.findings}
+    assert "gf-float" in rules, report.findings
+    assert "host-sync" in rules, report.findings
+
+
+def test_injected_fault_fails_cli(tmp_path):
+    pkg = tmp_path / "ops"
+    pkg.mkdir()
+    (pkg / "injected.py").write_text(INJECTED)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_lint.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "gf-float" in r.stdout
+    assert "host-sync" in r.stdout
+
+
+def test_clean_tree_passes_cli(tmp_path):
+    (tmp_path / "fine.py").write_text("x = 1\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_lint.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_json_schema(tmp_path):
+    pkg = tmp_path / "codes"
+    pkg.mkdir()
+    (pkg / "injected.py").write_text(INJECTED)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_lint.py"),
+         "--json", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    payload = json.loads(r.stdout)
+    assert payload["ok"] is False
+    assert payload["files"] == 1
+    assert {f["rule"] for f in payload["findings"]} >= {"gf-float",
+                                                        "host-sync"}
+    f0 = payload["findings"][0]
+    assert {"rule", "path", "line", "col", "message"} <= set(f0)
+
+
+def test_cli_list_rules():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_lint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    for rule_id in RULE_IDS:
+        assert rule_id in r.stdout
+
+
+# ----------------------------------------------------------------------
+# suppression + region mechanics
+def test_disable_file_pragma():
+    src = ("# tpu-lint: disable-file=gf-float -- generated ladder\n"
+           "# tpu-lint: scope=gf\n"
+           "x = 1.5\ny = 2.5\n")
+    rep = lint_source(src, "ceph_tpu/gf/gen.py")
+    assert not rep.findings
+    assert len(rep.suppressed) == 2
+
+
+def test_suppression_is_rule_scoped():
+    # a gf-float disable must not hide a gf-python-op finding
+    src = ("# tpu-lint: scope=gf\n"
+           "from ceph_tpu.gf.gf8 import gf8\n"
+           "g = gf8()\n"
+           "p = g.exp[1] * 1.5  # tpu-lint: disable=gf-float -- why\n")
+    rep = lint_source(src, "ceph_tpu/gf/x.py")
+    assert {f.rule for f in rep.findings} == {"gf-python-op"}
+    assert {f.rule for f in rep.suppressed} == {"gf-float"}
+
+
+def test_jit_function_marker():
+    src = ("import numpy as np\n"
+           "def factory():\n"
+           "    # tpu-lint: jit-function\n"
+           "    def fn(x):\n"
+           "        return np.asarray(x)\n"
+           "    return fn\n")
+    rep = lint_source(src, "ceph_tpu/crush/x.py")
+    assert [f.rule for f in rep.findings] == ["host-sync"]
+
+
+def test_scope_pragma_opts_out():
+    src = "# tpu-lint: scope=host\nx = 1.5\n"
+    rep = lint_source(src, "ceph_tpu/gf/host_tool.py")
+    assert not rep.findings
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def broken(:\n")
+    rep = lint_file(str(p))
+    assert [f.rule for f in rep.findings] == ["parse-error"]
+
+
+def test_rule_registry_consistent():
+    assert set(RULES_BY_ID) == set(RULE_IDS)
+    for rule in ALL_RULES:
+        assert rule.id and rule.description and rule.category

@@ -3,4 +3,8 @@
 # The default `pytest -q` selection skips them to keep the edit-test
 # loop under ~5 minutes (VERDICT r03 Next#9).
 cd "$(dirname "$0")/.."
+# Static gate first: tpu-lint must be clean before anything compiles.
+# (The same gate runs inside tier-1 as tests/test_tpu_lint.py; running
+# it here too makes a lint regression fail in seconds, not minutes.)
+python tools/tpu_lint.py ceph_tpu/ tools/ || exit 1
 CEPH_TPU_FULL=1 exec python -m pytest tests/ -q "$@"

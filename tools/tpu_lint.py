@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""tpu-lint CLI — static device-invariant checks for ceph_tpu.
+
+Usage:
+    python tools/tpu_lint.py [paths...]        # default: ceph_tpu/
+    python tools/tpu_lint.py --json ceph_tpu/  # machine-readable
+    python tools/tpu_lint.py --list-rules
+    python tools/tpu_lint.py --show-suppressed ceph_tpu/ops
+
+Exit status: 0 when no unsuppressed findings, 1 otherwise.  Rules,
+suppression syntax (`# tpu-lint: disable=<rule> -- reason`) and the
+relationship to the runtime CEPH_TPU_VERIFY sanitizer are documented
+in docs/LINT.md.
+
+The linter is pure stdlib-ast analysis: it never imports the scanned
+code, so it runs in any environment (no jax needed).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.analysis import (LintConfig, lint_paths, render_human,
+                               render_json)
+from ceph_tpu.analysis.report import render_rules
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-lint",
+        description="AST static analysis for device purity, dtype and "
+                    "recompilation invariants")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: ceph_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="JSON output")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every rule and exit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="ID", help="run only these rule ids")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(render_rules())
+        return 0
+
+    paths = args.paths or [os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "ceph_tpu")]
+    config = LintConfig(
+        enabled_rules=frozenset(args.rule) if args.rule else None)
+    report = lint_paths(paths, config)
+    if args.json:
+        print(render_json(report))
+    else:
+        print(render_human(report, show_suppressed=args.show_suppressed))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
