@@ -79,6 +79,40 @@ DECODE_ROWS = [
       "--device", "jax", "--batch", "16", "--loop", "64"]),
 ]
 
+# Degraded / recovery-path rows (ISSUE 2): deep-scrub verify + repair
+# GB/s for the north-star RS shape at 0 faults (pure scrub verify), 1
+# erasure, and the full m-fault budget spent as m-1 erasures + 1
+# corruption (the corruption exercises detect→demote→decode, not just
+# decode).  Host-side by design — the scrub crc and classification are
+# host math, so these rows track recovery-path performance even when
+# the tunnel is down.
+DEGRADED_COMMON = ["--plugin", "jerasure",
+                   "--parameter", "technique=reed_sol_van",
+                   "--parameter", "k=8", "--parameter", "m=3",
+                   "--size", str(1 << 20), "--workload", "degraded",
+                   "--device", "host", "--batch", "4"]
+DEGRADED_ROWS = [
+    ("rs_k8_m3_scrub_e0", ["-e", "0"]),
+    ("rs_k8_m3_degraded_e1", ["-e", "1"]),
+    ("rs_k8_m3_degraded_e2_c1", ["-e", "2", "--corruptions", "1"]),
+]
+
+
+def _degraded_rows(iterations: int) -> dict:
+    """name -> GB/s (None on failure) for the recovery-path rows."""
+    rows = {}
+    for name, extra in DEGRADED_ROWS:
+        try:
+            rows[name] = round(_run(
+                DEGRADED_COMMON + ["--iterations", str(iterations)]
+                + extra)["gbps"], 4)
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"degraded/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
+
+
 # C++ AVX2 RS plugin, k=8 m=3, 1 MiB stripes, 100 iters, this host
 # (2026-07-29; see BASELINE.md row ★).  Used only when the native build
 # is absent at bench time.
@@ -145,6 +179,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "baseline_gbps": round(cpp_gbps, 3),
         "error": msg,
         "host_gbps": round(host_gbps, 3),
+        "degraded_rows": _degraded_rows(iterations=1),
         "last_good": _read_last_good(),
     }
 
@@ -236,7 +271,10 @@ def main() -> int:
                 "--device", "jax", "--batch", "64",
                 "--loop", "1024", "--layout", layout,
                 "--chain", chain]))
-        except Exception as e:  # noqa: BLE001 - recorded in error line
+        # SystemExit included: the slice-chain honesty gate raises it
+        # on non-TPU backends — without this the whole run died with
+        # no JSON line on a CPU-only machine
+        except (Exception, SystemExit) as e:  # noqa: BLE001
             errors.append(f"encode/{layout}/{chain}: "
                           f"{type(e).__name__}: {e}")
     # per-call (includes tunnel dispatch latency), for continuity
@@ -261,7 +299,7 @@ def main() -> int:
     for name, argv in DECODE_ROWS:
         try:
             decode_rows[name] = round(_run(argv)["gbps"], 3)
-        except Exception as e:  # noqa: BLE001
+        except (Exception, SystemExit) as e:  # noqa: BLE001
             errors.append(f"decode/{name}: {type(e).__name__}: {e}")
             decode_rows[name] = None
     best = max(candidates, key=lambda r: r["gbps"])
@@ -287,6 +325,7 @@ def main() -> int:
         "percall_gbps": round(percall["gbps"], 3) if percall else None,
         "decode_gbps": decode_rows.get("rs_k8_m3_e2"),
         "decode_rows": decode_rows,
+        "degraded_rows": _degraded_rows(iterations=3),
         "vs_host_groundtruth": round(best["gbps"] / host["gbps"], 3)
         if host["gbps"] > 0 else None,
     }

@@ -19,6 +19,13 @@ capabilities of the reference (agraf/ceph, a fork of ceph/ceph):
                           crush_do_rule, and a vmapped bulk evaluator
                           (mirrors src/crush/).
 - ``ceph_tpu.parallel`` — device-mesh sharding of the batched paths.
+- ``ceph_tpu.chaos``    — seeded deterministic fault injection
+                          (shard erasure/corruption/truncation,
+                          transient read errors) over a ShardStore.
+- ``ceph_tpu.scrub``    — deep-scrub → repair → OSDMap-remap pipeline
+                          (PGScrubber/ECBackend recovery analog) with
+                          structured degraded-mode errors
+                          (docs/ROBUSTNESS.md).
 - ``ceph_tpu.bench``    — CLI harness mirroring
                           src/test/erasure-code/ceph_erasure_code_benchmark.cc
                           and src/tools/crushtool.cc --test.

@@ -108,14 +108,20 @@ class ErasureCodeBench:
         ap.add_argument("-p", "--plugin", default="jerasure",
                         help="erasure code plugin name")
         ap.add_argument("-w", "--workload", default="encode",
-                        choices=["encode", "decode"])
+                        choices=["encode", "decode", "degraded"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
         ap.add_argument("-P", "--parameter", action="append", default=[],
                         help="profile parameter name=value (repeatable)")
         ap.add_argument("-e", "--erasures", type=int, default=1,
-                        help="number of chunks to erase (decode workload)")
+                        help="number of chunks to erase "
+                             "(decode/degraded workloads)")
+        ap.add_argument("--corruptions", type=int, default=0,
+                        help="shards to bit-flip per iteration "
+                             "(degraded workload: scrub must detect "
+                             "them, then repair treats them as "
+                             "erasures)")
         ap.add_argument("-E", "--erasures-generation", default="random",
                         choices=["random", "exhaustive"], dest="erasures_generation")
         ap.add_argument("--erased", action="append", type=int, default=None,
@@ -495,9 +501,77 @@ class ErasureCodeBench:
             print(_json.dumps(global_perf().dump()), file=_sys.stderr)
         return res
 
+    # -- degraded (recovery path: no reference analogue — the scrub →
+    # repair loop timed as a workload, ISSUE 2 / docs/ROBUSTNESS.md) ----
+
+    def degraded(self) -> dict:
+        """Recovery-path throughput: deep_scrub (vectorized crc verify +
+        classify) + repair (decode, re-encode, crc re-verify) of an
+        object with --erasures shards erased and --corruptions shards
+        bit-flipped.  Fault injection and store setup run OUTSIDE the
+        timer; GB/s is logical object bytes / elapsed — the
+        client-visible recovery bandwidth.  With -e 0 and no
+        corruptions this times the pure deep-scrub verify pass."""
+        from ..chaos import BitFlip, ShardErasure, inject
+        from ..codes.stripe import HashInfo, StripeInfo
+        from ..codes.stripe import encode as stripe_encode
+        from ..scrub import repair
+        a = self.args
+        ec = self._instance()
+        n = ec.get_chunk_count()
+        k = ec.get_data_chunk_count()
+        if a.erasures < 0 or a.corruptions < 0:
+            raise ValueError("--erasures/--corruptions must be >= 0")
+        if a.erasures + a.corruptions >= n:
+            raise ValueError(
+                f"{a.erasures} erasures + {a.corruptions} corruptions "
+                f"leave no clean shards of {n}")
+        chunk_size = ec.get_chunk_size(a.size)
+        width = k * chunk_size
+        sinfo = StripeInfo(k, width)
+        rng = np.random.default_rng(a.seed)
+        obj = rng.integers(0, 256, size=width * a.batch,
+                           dtype=np.uint8).tobytes()
+        shards = stripe_encode(sinfo, ec, obj)
+        hinfo = HashInfo(n)
+        hinfo.append(0, shards)
+
+        def make_store(it: int):
+            # deterministic per-iteration victim pattern; repair heals
+            # the store in place, so every timed pass gets a fresh one
+            prng = np.random.default_rng(a.seed + 1000 * it)
+            victims = prng.choice(n, size=a.erasures + a.corruptions,
+                                  replace=False)
+            injectors = []
+            erased = [int(v) for v in victims[:a.erasures]]
+            flipped = [int(v) for v in victims[a.erasures:]]
+            if erased:
+                injectors.append(ShardErasure(shards=erased))
+            if flipped:
+                injectors.append(BitFlip(shards=flipped, flips=1))
+            store, _ = inject(shards, injectors, seed=a.seed + it,
+                              chunk_size=sinfo.chunk_size)
+            return store
+
+        # warm every per-pattern decode-matrix cache outside the timer
+        # (mirrors the decode workload's warmup-per-distinct-pattern)
+        for it in range(a.iterations):
+            repair(sinfo, ec, make_store(it), hinfo)
+        stores = [make_store(it) for it in range(a.iterations)]
+        begin = time.perf_counter()
+        for store in stores:
+            repair(sinfo, ec, store, hinfo)
+        elapsed = time.perf_counter() - begin
+        res = self._result("degraded", elapsed, len(obj) * a.iterations)
+        res["erasures"] = a.erasures
+        res["corruptions"] = a.corruptions
+        return res
+
     def _run_workload(self) -> dict:
         if self.args.workload == "encode":
             return self.encode()
+        if self.args.workload == "degraded":
+            return self.degraded()
         return self.decode()
 
 

@@ -1,0 +1,25 @@
+"""ceph_tpu.chaos — deterministic fault injection.
+
+Seeded, composable injectors that damage stored shards (erasure,
+bit-flips, truncation, stripe zeroing) and the read path (transient
+backend errors), over an ObjectStore-like ShardStore.  The scrub
+pipeline (ceph_tpu.scrub), the fuzz suites, the degraded benchmark
+and tools/scrub_demo.py all drive the same injectors, so every
+robustness claim replays from a (seed, injector list) pair.  See
+docs/ROBUSTNESS.md.
+"""
+
+from .injectors import (  # noqa: F401
+    BitFlip,
+    Compose,
+    Fault,
+    Injector,
+    ShardErasure,
+    TransientErrors,
+    Truncate,
+    ZeroStripe,
+    damaged_shards,
+    inject,
+    random_injectors,
+)
+from .store import ShardStore, ensure_store  # noqa: F401

@@ -65,6 +65,16 @@ def _mat_apply(mat: np.ndarray, v: int) -> int:
     return int(np.bitwise_xor.reduce(sel)) if sel.size else 0
 
 
+def _mat_apply_vec(mat: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """_mat_apply over a VECTOR of CRC states at once (uint32 in/out):
+    out = XOR of basis images mat[b] wherever state bit b is set."""
+    out = np.zeros_like(v)
+    for b in range(32):
+        out ^= np.where((v >> np.uint32(b)) & np.uint32(1),
+                        mat[b], np.uint32(0))
+    return out
+
+
 def _mat_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return np.array([_mat_apply(a, int(c)) for c in b], dtype=np.uint32)
 
@@ -123,6 +133,42 @@ def ceph_crc32c(crc: int, data: bytes) -> int:
     for s in states:
         out = _mat_apply(adv, out) ^ int(s)
     return _crc_scalar(out, buf[n_blocks * _BLOCK:])
+
+
+def ceph_crc32c_batch(crcs, bufs: np.ndarray) -> np.ndarray:
+    """Vectorized ceph_crc32c across MANY equal-length buffers: (B,)
+    seed states + (B, L) uint8 rows -> (B,) uint32 CRCs.
+
+    The scrub pipeline's verify step: all shards of an object (or all
+    chunks of a stripe batch) hash in ONE call.  Same construction as
+    ceph_crc32c — every _BLOCK-byte lane of every row steps together
+    (one numpy op per byte column, B*n_blocks lanes wide), then the
+    GF(2) zero-advance fold runs vectorized across rows
+    (_mat_apply_vec).  Byte-identical to the scalar loop; pinned in
+    tests/test_scrub.py."""
+    bufs = np.ascontiguousarray(bufs)
+    if bufs.dtype != np.uint8 or bufs.ndim != 2:
+        raise ValueError("bufs must be a (B, L) uint8 array")
+    b_rows, length = bufs.shape
+    out = np.asarray(crcs, dtype=np.uint64).astype(np.uint32)
+    if out.shape != (b_rows,):
+        raise ValueError(f"need {b_rows} seed crcs, got {out.shape}")
+    if length < 2 * _BLOCK:
+        return np.array([_crc_scalar(int(out[i]), bufs[i])
+                         for i in range(b_rows)], dtype=np.uint32)
+    n_blocks = length // _BLOCK
+    body = bufs[:, :n_blocks * _BLOCK].reshape(b_rows, n_blocks, _BLOCK)
+    states = np.zeros((b_rows, n_blocks), dtype=np.uint32)
+    tab = _CRC_TABLE32
+    for i in range(_BLOCK):
+        states = (states >> np.uint32(8)) ^ tab[
+            (states ^ body[:, :, i]) & np.uint32(0xFF)]
+    adv = _advance_matrix(_BLOCK)
+    for j in range(n_blocks):
+        out = _mat_apply_vec(adv, out) ^ states[:, j]
+    tail = bufs[:, n_blocks * _BLOCK:]
+    return np.array([_crc_scalar(int(out[i]), tail[i])
+                     for i in range(b_rows)], dtype=np.uint32)
 
 
 class HashInfo:
@@ -200,10 +246,20 @@ class StripeInfo:
 
 def _chunk_mapping(ec) -> List[int]:
     """get_chunk_mapping(), defaulting to identity (ErasureCode.cc:
-    an empty mapping means chunk i lives on shard i)."""
+    an empty mapping means chunk i lives on shard i).
+
+    Codes whose mapping names only the k DATA positions (lrc) are
+    completed with the parity positions in ascending order — exactly
+    the order encode_chunks_batch emits parity rows — so mapping[i]
+    is the shard of data chunk i for i < k and of parity j for
+    i == k + j, for every plugin."""
+    n = ec.get_chunk_count()
     mapping = list(ec.get_chunk_mapping() or [])
     if not mapping:
-        mapping = list(range(ec.get_chunk_count()))
+        return list(range(n))
+    if len(mapping) < n:
+        data = set(mapping)
+        mapping = mapping + [p for p in range(n) if p not in data]
     return mapping
 
 
@@ -284,24 +340,26 @@ def read(sinfo: StripeInfo, ec, shards: Dict[int, bytes],
     one batched decode call for all touched stripes."""
     k = ec.get_data_chunk_count()
     mapping = _chunk_mapping(ec)
-    inv = {shard: chunk for chunk, shard in enumerate(mapping)}
     start, n_stripes, c0, c1 = _touched_range(sinfo, shards, offset,
                                               length)
     if length == 0:
         return b""
 
-    have_chunks = {inv[s] for s in shards}
-    want_data = set(range(k))
-    missing = want_data - have_chunks
+    # minimum_to_decode / decode speak SHARD space (identical to chunk
+    # ids for identity-mapped plugins; global positions for lrc)
+    have_shards = set(shards)
+    missing_shards = {mapping[c] for c in range(k)} - have_shards
     sub: Dict[int, bytes] = {}
-    for chunk in want_data & have_chunks:
-        sub[chunk] = shards[mapping[chunk]][c0:c1]
-    if missing:
-        plan = ec.minimum_to_decode(missing, have_chunks)
-        reads = {mapping[c]: shards[mapping[c]][c0:c1] for c in plan}
-        rec = decode(sinfo, ec, reads, {mapping[c] for c in missing})
-        for chunk in missing:
-            sub[chunk] = rec[mapping[chunk]]
+    for chunk in range(k):
+        if mapping[chunk] in have_shards:
+            sub[chunk] = shards[mapping[chunk]][c0:c1]
+    if missing_shards:
+        plan = ec.minimum_to_decode(missing_shards, have_shards)
+        reads = {s: shards[s][c0:c1] for s in plan}
+        rec = decode(sinfo, ec, reads, missing_shards)
+        for chunk in range(k):
+            if mapping[chunk] in missing_shards:
+                sub[chunk] = rec[mapping[chunk]]
 
     window = _window_bytes(sinfo, sub, k, n_stripes)
     lo = offset - start
@@ -342,10 +400,13 @@ def overwrite(sinfo: StripeInfo, ec, shards: Dict[int, bytes],
 def decode(sinfo: StripeInfo, ec, to_decode: Dict[int, bytes],
            want_to_read: Iterable[int]) -> Dict[int, bytes]:
     """ECUtil.cc → ECUtil::decode: surviving shard buffers → wanted
-    shard buffers, all stripes in one batched device call."""
+    shard buffers, all stripes in one batched device call.
+
+    available/erased are passed to the plugin in SHARD space — the
+    space decode_chunks_batch already speaks for every plugin
+    (identity chunk ids for jerasure/isa/shec/clay, global positions
+    for lrc)."""
     want = sorted(set(want_to_read))
-    mapping = _chunk_mapping(ec)
-    inv = {shard: chunk for chunk, shard in enumerate(mapping)}
     lengths = {len(v) for v in to_decode.values()}
     if len(lengths) != 1:
         raise ValueError("uneven shard buffers")
@@ -358,14 +419,13 @@ def decode(sinfo: StripeInfo, ec, to_decode: Dict[int, bytes],
     out: Dict[int, bytes] = {s: have[s] for s in want if s in have}
     if not missing:
         return out
-    available = tuple(sorted(inv[s] for s in have))
-    erased_chunks = tuple(sorted(inv[s] for s in missing))
+    available = tuple(sorted(have))
+    erased = tuple(sorted(missing))
     stack = np.stack([
-        np.frombuffer(have[mapping[c]], dtype=np.uint8).reshape(
+        np.frombuffer(have[s], dtype=np.uint8).reshape(
             n_stripes, sinfo.chunk_size)
-        for c in available], axis=1)            # (n_stripes, n_avail, C)
-    rec = ec.decode_chunks_batch(stack, available, erased_chunks)
-    for idx, chunk in enumerate(erased_chunks):
-        out[mapping[chunk]] = np.ascontiguousarray(
-            rec[:, idx, :]).tobytes()
+        for s in available], axis=1)            # (n_stripes, n_avail, C)
+    rec = ec.decode_chunks_batch(stack, available, erased)
+    for idx, s in enumerate(erased):
+        out[s] = np.ascontiguousarray(rec[:, idx, :]).tobytes()
     return out

@@ -36,6 +36,14 @@ from ..ops.xla_ops import (
 )
 
 
+def _numpy_tier() -> bool:
+    """True when the fallback policy has dropped to the numpy ground
+    truth (no XLA backend initializes, or CEPH_TPU_ENGINE=numpy) — the
+    batched paths must then never dispatch through jax at any size."""
+    from ..ops.fallback import global_policy
+    return global_policy().engine() == "numpy"
+
+
 class MatrixCodeMixin:
     """Compute paths for GF(2^w)-element matrix codes.
 
@@ -56,7 +64,7 @@ class MatrixCodeMixin:
                matrix_static) -> np.ndarray:
         perf = global_perf()
         words = regionops.words_view(np.ascontiguousarray(chunks), self.w)
-        if chunks.nbytes < self.min_xla_bytes:
+        if chunks.nbytes < self.min_xla_bytes or _numpy_tier():
             perf.inc("ec_host_calls")
             perf.inc("ec_host_bytes", chunks.nbytes)
             return regionops.matrix_encode(words, matrix, self.w).view(np.uint8)
@@ -156,7 +164,7 @@ class BitmatrixCodeMixin:
     def _apply(self, chunks: np.ndarray, bitmatrix: np.ndarray,
                bitmatrix_static) -> np.ndarray:
         perf = global_perf()
-        if chunks.nbytes < self.min_xla_bytes:
+        if chunks.nbytes < self.min_xla_bytes or _numpy_tier():
             perf.inc("ec_host_calls")
             perf.inc("ec_host_bytes", chunks.nbytes)
             return regionops.bitmatrix_encode(chunks, bitmatrix, self.w,

@@ -1,0 +1,129 @@
+"""Explicit Pallas → XLA → numpy backend fallback policy.
+
+Before this module, engine selection was scattered and silent: the
+device probe in ``pallas_gf`` swallowed every exception with a bare
+``except Exception`` and quietly answered "cpu", so a broken jax
+install, a wedged tunnel, or a typo'd platform string all looked like
+a deliberate CPU run.  The policy object makes the three-tier ladder
+(SURVEY §2.3: Pallas kernels on TPU → XLA SWAR everywhere else →
+numpy ground truth when no XLA backend initializes) an explicit,
+observable decision:
+
+- the probe catches only the exception types jax actually raises for
+  "no usable backend" (RuntimeError from backend init, ImportError
+  from a broken install) — anything else is a real bug and propagates;
+- the selected engine is logged ONCE per distinct (device, engine)
+  outcome through utils.log (``CEPH_TPU_DEBUG=ec=1`` shows it);
+- ``CEPH_TPU_ENGINE=pallas|xla|numpy`` force-overrides for tests and
+  benches, replacing ad-hoc monkeypatching of the probe.
+
+``pallas_gf.use_pallas`` and the mixin host/device split in
+``codes/techniques.py`` route through ``global_policy()``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+from ..utils.log import dout
+
+ENGINES = ("pallas", "xla", "numpy")
+
+# device kind reported when no XLA backend can initialize at all — the
+# numpy tier (the probe error is kept for the log line)
+NO_BACKEND = "none"
+
+
+class FallbackPolicy:
+    """Maps the probed device kind to a compute engine tier.
+
+    tpu → pallas (Mosaic lowers there; the axon tunnel reports "tpu"
+    too), any other live backend → xla, no backend at all → numpy.
+    """
+
+    def __init__(self, force: Optional[str] = None) -> None:
+        env = os.environ.get("CEPH_TPU_ENGINE", "").strip().lower()
+        self.force = force if force is not None else (env or None)
+        if self.force is not None and self.force not in ENGINES:
+            raise ValueError(
+                f"engine {self.force!r} must be one of {ENGINES}")
+        self.probe_error: Optional[BaseException] = None
+        self._logged: set = set()
+        self._lock = threading.Lock()
+        self._kind: Optional[str] = None
+
+    # -- probe -----------------------------------------------------------
+
+    def device_kind(self) -> str:
+        """The default jax backend platform, or NO_BACKEND.
+
+        jax.default_backend() raises RuntimeError when no platform
+        initializes (and ImportError surfaces a broken install); both
+        mean "drop to the numpy tier".  Nothing else is swallowed.
+        The probe result is cached — backend identity cannot change
+        mid-process, and the hot host paths ask on every batch.
+        """
+        if self._kind is not None:
+            return self._kind
+        import jax
+        try:
+            kind = jax.default_backend()
+        except (RuntimeError, ImportError) as e:
+            self.probe_error = e
+            kind = NO_BACKEND
+        self._kind = kind
+        return kind
+
+    # -- selection -------------------------------------------------------
+
+    def engine(self, device_kind: Optional[str] = None) -> str:
+        """The engine tier for ``device_kind`` (probed when omitted)."""
+        if self.force is not None:
+            kind = device_kind if device_kind is not None else "forced"
+            self._log_once(kind, self.force, forced=True)
+            return self.force
+        if device_kind is None:
+            device_kind = self.device_kind()
+        if device_kind == "tpu":
+            eng = "pallas"
+        elif device_kind == NO_BACKEND:
+            eng = "numpy"
+        else:
+            eng = "xla"
+        self._log_once(device_kind, eng)
+        return eng
+
+    def _log_once(self, kind: str, eng: str, forced: bool = False) -> None:
+        key: Tuple[str, str] = (kind, eng)
+        with self._lock:
+            if key in self._logged:
+                return
+            self._logged.add(key)
+        why = "forced via CEPH_TPU_ENGINE" if forced else f"device={kind}"
+        tail = (f"; probe error: {type(self.probe_error).__name__}: "
+                f"{self.probe_error}" if self.probe_error else "")
+        dout("ec", 1, f"backend fallback policy: engine={eng} ({why}){tail}")
+
+
+_global: Optional[FallbackPolicy] = None
+_global_lock = threading.Lock()
+
+
+def global_policy() -> FallbackPolicy:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = FallbackPolicy()
+        return _global
+
+
+def set_global_policy(policy: Optional[FallbackPolicy]) -> \
+        Optional[FallbackPolicy]:
+    """Swap the process policy (tests); returns the previous one."""
+    global _global
+    with _global_lock:
+        prev = _global
+        _global = policy
+        return prev

@@ -416,17 +416,22 @@ def apply_bitmatrix_pallas(chunks: jax.Array, bitmatrix_rows, w: int,
 
 
 def _device_kind() -> str:
-    try:
-        return jax.default_backend()
-    except Exception:  # pragma: no cover - backend probing never raises
-        return "cpu"
+    """Probed default-backend kind, via the explicit fallback policy
+    (ops/fallback.py — specific exception types only, no silent
+    swallowing; "none" means no XLA backend initializes).  Kept as a
+    module-level function so tests can pin the device kind."""
+    from .fallback import global_policy
+    return global_policy().device_kind()
 
 
 def use_pallas() -> bool:
     """The kernel lowers through Mosaic for TPU backends only (the
     axon tunnel reports backend "tpu" too); every other backend —
-    cpu, gpu — takes the XLA path (interpreter mode is for tests)."""
-    return _device_kind() == "tpu"
+    cpu, gpu — takes the XLA path (interpreter mode is for tests).
+    Routed through the fallback policy, which logs the selected
+    engine once per outcome."""
+    from .fallback import global_policy
+    return global_policy().engine(_device_kind()) == "pallas"
 
 
 # NONZERO-entry count above which a GF(2^8) matrix routes to the MXU

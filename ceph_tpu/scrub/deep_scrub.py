@@ -1,0 +1,388 @@
+"""Deep-scrub → repair → remap — the PGScrubber/ECBackend recovery loop.
+
+Reference: src/osd/scrubber/pg_scrubber.cc + ScrubStore (deep scrub
+reads every shard and compares stored digests), ECBackend's recovery
+path (ReadOp/RecoveryOp: minimum_to_decode over survivors →
+decode_chunks → write the rebuilt shard, gated on the HashInfo crc),
+and the mon's response to scrub errors (mark the bad OSD out, let
+CRUSH remap).  The daemons are out of scope; this module is that loop
+as pure math over a ShardStore:
+
+1. ``deep_scrub``   — read every shard (bounded retry over transient
+   errors, utils/retry.py), verify ALL shards against HashInfo crc32c
+   in ONE vectorized CRC call (stripe.ceph_crc32c_batch), classify
+   clean / missing / corrupt.
+2. ``repair``       — demote corrupt shards to erasures, plan with
+   minimum_to_decode, reconstruct with the plugin's batched decode,
+   RE-ENCODE the object and require byte-identical parity plus
+   matching recomputed CRCs before writing anything back; raise a
+   structured UnrecoverableError naming shards AND logical extents
+   when the faults exceed the code's budget.
+3. ``apply_osd_feedback`` — feed confirmed-bad OSDs into
+   OSDMap.mark_down/mark_out so CRUSH remaps, closing the
+   placement↔EC loop.
+
+``read_degraded`` is the client-facing composition: a degraded-mode
+read that treats corrupt shards as erasures and NEVER returns garbage
+— past the failure budget it raises with the precise unrecoverable
+extents of the requested range.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..chaos.store import ShardStore, ensure_store
+from ..codes import stripe as stripe_mod
+from ..codes.stripe import HashInfo, StripeInfo, ceph_crc32c_batch
+from ..utils.errors import (
+    RetryExhausted,
+    ScrubError,
+    UnrecoverableError,
+)
+from ..utils.log import dout
+from ..utils.retry import RetryPolicy, retry_call
+
+CRC_SEED = 0xFFFFFFFF  # HashInfo's cumulative seed (-1, ECUtil.h)
+
+
+class ShardState(enum.Enum):
+    CLEAN = "clean"
+    MISSING = "missing"
+    CORRUPT = "corrupt"
+
+
+@dataclass(frozen=True)
+class ShardVerdict:
+    """One shard's scrub outcome (expected/actual crc kept for the
+    report; actual is None when the shard never produced bytes)."""
+
+    shard: int
+    state: ShardState
+    expected_crc: int
+    actual_crc: Optional[int] = None
+    length: Optional[int] = None
+    error: str = ""
+
+
+@dataclass
+class ScrubReport:
+    """deep_scrub's classification of every shard of one object."""
+
+    verdicts: Dict[int, ShardVerdict] = field(default_factory=dict)
+    shard_length: int = 0          # expected per-shard bytes (HashInfo)
+    retried_shards: Tuple[int, ...] = ()
+
+    def _with(self, state: ShardState) -> List[int]:
+        return sorted(s for s, v in self.verdicts.items()
+                      if v.state is state)
+
+    @property
+    def clean(self) -> List[int]:
+        return self._with(ShardState.CLEAN)
+
+    @property
+    def missing(self) -> List[int]:
+        return self._with(ShardState.MISSING)
+
+    @property
+    def corrupt(self) -> List[int]:
+        return self._with(ShardState.CORRUPT)
+
+    @property
+    def bad(self) -> List[int]:
+        """Shards needing repair: missing + corrupt."""
+        return sorted(self.missing + self.corrupt)
+
+    @property
+    def is_clean(self) -> bool:
+        return not self.bad
+
+
+@dataclass
+class RepairReport:
+    """repair's outcome: which shards were rebuilt and the proof."""
+
+    scrub: ScrubReport
+    repaired: Dict[int, bytes] = field(default_factory=dict)
+    reencode_verified: bool = False
+    crc_verified: bool = False
+
+
+@dataclass
+class RemapReport:
+    """apply_osd_feedback's outcome."""
+
+    marked_osds: Tuple[int, ...] = ()
+    old_acting: Tuple[int, ...] = ()
+    new_acting: Tuple[int, ...] = ()
+
+    @property
+    def moved(self) -> Dict[int, Tuple[int, int]]:
+        """shard slot -> (old osd, new osd) for slots that remapped."""
+        return {i: (o, n) for i, (o, n) in
+                enumerate(zip(self.old_acting, self.new_acting)) if o != n}
+
+
+# -- stage 1: deep scrub -------------------------------------------------
+
+def deep_scrub(sinfo: StripeInfo, ec, store, hinfo: HashInfo, *,
+               retry_policy: Optional[RetryPolicy] = None,
+               clock=None) -> ScrubReport:
+    """Read + verify + classify every shard of one object.
+
+    Transient read errors retry under ``retry_policy`` (injectable
+    ``clock``: tests run the whole backoff schedule without sleeping);
+    a shard whose retries exhaust is classified MISSING with the error
+    recorded.  Wrong-length shards are CORRUPT immediately (truncation
+    can't crc-match a cumulative hash); everything else verifies
+    against HashInfo in one ceph_crc32c_batch call across all shards.
+    """
+    store = ensure_store(store, chunk_size=sinfo.chunk_size)
+    n = ec.get_chunk_count()
+    expected_len = hinfo.total_chunk_size
+    policy = retry_policy or RetryPolicy()
+    verdicts: Dict[int, ShardVerdict] = {}
+    retried: List[int] = []
+    bufs: Dict[int, bytes] = {}
+    for s in range(n):
+        failures = store.transient.get(s, 0) if isinstance(
+            store, ShardStore) else 0
+        try:
+            bufs[s] = retry_call(store.read, s, policy=policy,
+                                 clock=clock)
+            if failures:
+                retried.append(s)
+        except KeyError:
+            verdicts[s] = ShardVerdict(s, ShardState.MISSING,
+                                       hinfo.get_chunk_hash(s),
+                                       error="shard not in store")
+        except RetryExhausted as e:
+            verdicts[s] = ShardVerdict(s, ShardState.MISSING,
+                                       hinfo.get_chunk_hash(s),
+                                       error=str(e))
+    # length gate: a cumulative crc only speaks over full-length shards
+    full: List[int] = []
+    for s, b in bufs.items():
+        if len(b) != expected_len:
+            verdicts[s] = ShardVerdict(
+                s, ShardState.CORRUPT, hinfo.get_chunk_hash(s),
+                length=len(b),
+                error=f"length {len(b)} != expected {expected_len}")
+        else:
+            full.append(s)
+    if full:
+        stack = np.stack([np.frombuffer(bufs[s], dtype=np.uint8)
+                          for s in full])
+        actual = ceph_crc32c_batch([CRC_SEED] * len(full), stack)
+        for i, s in enumerate(full):
+            want = hinfo.get_chunk_hash(s)
+            got = int(actual[i])
+            state = (ShardState.CLEAN if got == want
+                     else ShardState.CORRUPT)
+            verdicts[s] = ShardVerdict(
+                s, state, want, actual_crc=got, length=expected_len,
+                error="" if state is ShardState.CLEAN
+                else "crc mismatch")
+    report = ScrubReport(verdicts=verdicts, shard_length=expected_len,
+                         retried_shards=tuple(retried))
+    if report.bad:
+        dout("ec", 5, f"deep_scrub: missing={report.missing} "
+                      f"corrupt={report.corrupt}")
+    return report
+
+
+# -- unrecoverable extents ----------------------------------------------
+
+def unrecoverable_extents(sinfo: StripeInfo, ec, bad_shards,
+                          n_stripes: int,
+                          window: Optional[Tuple[int, int]] = None
+                          ) -> Tuple[Tuple[int, int], ...]:
+    """Logical (offset, length) ranges covered by lost DATA chunks,
+    merged where adjacent; parity shards carry no client bytes.
+    ``window`` clips to a requested (offset, length) read range."""
+    mapping = stripe_mod._chunk_mapping(ec)
+    inv = {shard: chunk for chunk, shard in enumerate(mapping)}
+    k = ec.get_data_chunk_count()
+    bad_chunks = sorted(inv[s] for s in bad_shards if inv[s] < k)
+    if not bad_chunks:
+        return ()
+    cs, width = sinfo.chunk_size, sinfo.stripe_width
+    lo, hi = 0, n_stripes * width
+    if window is not None:
+        lo, hi = window[0], window[0] + window[1]
+    spans: List[Tuple[int, int]] = []
+    for stripe_i in range(n_stripes):
+        for c in bad_chunks:
+            start = stripe_i * width + c * cs
+            end = start + cs
+            start, end = max(start, lo), min(end, hi)
+            if start >= end:
+                continue
+            if spans and spans[-1][0] + spans[-1][1] == start:
+                spans[-1] = (spans[-1][0], spans[-1][1] + end - start)
+            else:
+                spans.append((start, end - start))
+    return tuple(spans)
+
+
+# -- stage 2: repair -----------------------------------------------------
+
+def repair(sinfo: StripeInfo, ec, store, hinfo: HashInfo,
+           report: Optional[ScrubReport] = None, *,
+           retry_policy: Optional[RetryPolicy] = None,
+           clock=None, write_back: bool = True) -> RepairReport:
+    """Rebuild every bad shard, or raise structured errors.
+
+    Corrupt shards are demoted to erasures (their bytes are never fed
+    to the decoder); the plugin's own minimum_to_decode is the
+    feasibility oracle, so the failure budget is exactly the code's —
+    m for MDS, locality-dependent for lrc/shec/clay.  The repaired
+    object must survive BOTH gates before any write-back: re-encode
+    reproduces every shard byte-identically (parity included) and the
+    recomputed CRCs match HashInfo.
+    """
+    store = ensure_store(store, chunk_size=sinfo.chunk_size)
+    if report is None:
+        report = deep_scrub(sinfo, ec, store, hinfo,
+                            retry_policy=retry_policy, clock=clock)
+    if report.is_clean:
+        return RepairReport(scrub=report, reencode_verified=True,
+                            crc_verified=True)
+    n = ec.get_chunk_count()
+    n_stripes = report.shard_length // sinfo.chunk_size
+    mapping = stripe_mod._chunk_mapping(ec)
+    bad = report.bad
+    clean = report.clean
+
+    def _unrecoverable(cause=None):
+        return UnrecoverableError(
+            f"{len(bad)} shards lost/corrupt exceed the failure budget "
+            f"of this {ec.get_data_chunk_count()}+"
+            f"{ec.get_coding_chunk_count()} code",
+            shards=bad,
+            extents=unrecoverable_extents(sinfo, ec, bad, n_stripes),
+            cause=cause)
+
+    if len(clean) < ec.get_data_chunk_count():
+        raise _unrecoverable()
+    try:
+        # shard space: the space every plugin's decode path speaks
+        # (identity chunk ids, or lrc's global positions)
+        plan = ec.minimum_to_decode(set(bad), set(clean))
+    except (IOError, ValueError) as e:
+        raise _unrecoverable(cause=e) from e
+    reads = {s: retry_call(store.read, s, policy=retry_policy,
+                           clock=clock)
+             for s in plan}
+    rec = stripe_mod.decode(sinfo, ec, reads, set(bad))
+
+    # -- re-verify: re-encode and require byte identity + crc match ----
+    k = ec.get_data_chunk_count()
+    current: Dict[int, bytes] = {}
+    for s in range(n):
+        current[s] = rec[s] if s in rec else retry_call(
+            store.read, s, policy=retry_policy, clock=clock)
+    data_shards = {c: current[mapping[c]] for c in range(k)}
+    logical = stripe_mod._window_bytes(sinfo, data_shards, k, n_stripes)
+    reencoded = stripe_mod.encode(sinfo, ec, logical)
+    mismatch = [s for s in range(n) if reencoded[s] != current[s]]
+    if mismatch:
+        raise ScrubError(
+            "repair re-encode is not byte-identical to the surviving "
+            "shards — refusing to write back", shards=mismatch)
+    stack = np.stack([np.frombuffer(current[s], dtype=np.uint8)
+                      for s in range(n)])
+    crcs = ceph_crc32c_batch([CRC_SEED] * n, stack)
+    crc_bad = [s for s in range(n)
+               if int(crcs[s]) != hinfo.get_chunk_hash(s)]
+    if crc_bad:
+        raise ScrubError(
+            "repaired shards fail the HashInfo crc gate — refusing to "
+            "write back", shards=crc_bad)
+    if write_back:
+        for s in bad:
+            store.write(s, rec[s])
+    dout("ec", 5, f"repair: rebuilt shards {bad} "
+                  f"(read plan {sorted(plan)})")
+    return RepairReport(scrub=report,
+                        repaired={s: rec[s] for s in bad},
+                        reencode_verified=True, crc_verified=True)
+
+
+# -- stage 3: OSD feedback / CRUSH remap ---------------------------------
+
+def apply_osd_feedback(osdmap, pool_id: int, ps: int,
+                       acting, bad_shards) -> RemapReport:
+    """Mark the OSDs holding confirmed-bad shards down AND out, then
+    re-run the placement pipeline: CRUSH reweights and the pg's acting
+    set backfills away from the bad devices — the scrub result feeding
+    placement, like the mon reacting to scrub errors."""
+    from ..crush.types import CRUSH_ITEM_NONE
+    old = tuple(int(o) for o in acting)
+    marked = []
+    for s in sorted(set(bad_shards)):
+        osd = old[s]
+        if osd == CRUSH_ITEM_NONE:
+            continue
+        osdmap.mark_down(osd)
+        osdmap.mark_out(osd)
+        marked.append(osd)
+    _, _, new_acting, _ = osdmap.pg_to_up_acting_osds(pool_id, ps)
+    dout("crush", 5, f"scrub feedback: marked osds {marked} down+out; "
+                     f"pg {pool_id}.{ps} acting {list(old)} -> "
+                     f"{list(new_acting)}")
+    return RemapReport(marked_osds=tuple(marked), old_acting=old,
+                       new_acting=tuple(int(o) for o in new_acting))
+
+
+# -- degraded-mode read --------------------------------------------------
+
+def read_degraded(sinfo: StripeInfo, ec, store, hinfo: HashInfo,
+                  offset: int, length: int, *,
+                  retry_policy: Optional[RetryPolicy] = None,
+                  clock=None) -> bytes:
+    """Client read that survives ≤budget faults and NEVER returns
+    garbage: scrub-classify first (corrupt shards become erasures),
+    reconstruct through the normal read math, and past the budget
+    raise UnrecoverableError carrying the lost extents CLIPPED to the
+    requested range."""
+    store = ensure_store(store, chunk_size=sinfo.chunk_size)
+    report = deep_scrub(sinfo, ec, store, hinfo,
+                        retry_policy=retry_policy, clock=clock)
+    n_stripes = report.shard_length // sinfo.chunk_size
+    survivors = {s: retry_call(store.read, s, policy=retry_policy,
+                               clock=clock)
+                 for s in report.clean}
+    try:
+        return stripe_mod.read(sinfo, ec, survivors, offset, length)
+    except (IOError, ValueError) as e:
+        raise UnrecoverableError(
+            f"degraded read [{offset}, +{length}) cannot be served: "
+            f"{len(report.bad)} shards lost/corrupt",
+            shards=report.bad,
+            extents=unrecoverable_extents(sinfo, ec, report.bad,
+                                          n_stripes,
+                                          window=(offset, length)),
+            cause=e) from e
+
+
+def scrub_and_repair(sinfo: StripeInfo, ec, store, hinfo: HashInfo, *,
+                     osdmap=None, pool_id: Optional[int] = None,
+                     ps: Optional[int] = None, acting=None,
+                     retry_policy: Optional[RetryPolicy] = None,
+                     clock=None
+                     ) -> Tuple[RepairReport, Optional[RemapReport]]:
+    """The whole loop in one call: deep_scrub → repair → (when an
+    OSDMap context is given) mark bad OSDs and remap."""
+    rep = repair(sinfo, ec, store, hinfo, retry_policy=retry_policy,
+                 clock=clock)
+    remap = None
+    if osdmap is not None and rep.scrub.bad and acting is not None:
+        remap = apply_osd_feedback(osdmap, pool_id, ps, acting,
+                                   rep.scrub.bad)
+    return rep, remap

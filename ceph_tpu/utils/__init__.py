@@ -10,6 +10,11 @@
   erasure-code-profile store (`ceph osd erasure-code-profile`,
   OSDMonitor validation-by-instantiation).
 - ``log`` — dout-style per-subsystem leveled debug logging.
+- ``errors`` — the structured error taxonomy (TransientBackendError /
+  RetryExhausted / ScrubError / UnrecoverableError) shared by chaos/,
+  scrub/, retry and the backend fallback policy (docs/ROBUSTNESS.md).
+- ``retry`` — bounded retry/backoff with an injectable clock (no real
+  sleeps in tests).
 """
 
 from .perf import PerfCounters, global_perf, profile_trace  # noqa: F401
@@ -21,3 +26,17 @@ from .config import (  # noqa: F401
     global_config,
 )
 from .log import dout, get_level, set_level  # noqa: F401
+from .errors import (  # noqa: F401
+    CephTpuError,
+    RetryExhausted,
+    ScrubError,
+    TransientBackendError,
+    UnrecoverableError,
+)
+from .retry import (  # noqa: F401
+    FakeClock,
+    RetryPolicy,
+    RetryStats,
+    SystemClock,
+    retry_call,
+)

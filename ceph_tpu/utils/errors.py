@@ -1,0 +1,85 @@
+"""Structured error taxonomy for the robustness surfaces.
+
+The reference signals failure through int error codes threaded from
+the ObjectStore up through ECBackend (-EIO for a failed crc gate,
+-ENOENT for a missing shard) and out to the client; scrub and repair
+attach structured context (inconsistent-object lists, shard error
+maps — src/osd/scrubber/* and ECBackend::handle_sub_read).  Python
+surfaces raise instead, and these classes are the shared vocabulary:
+every deliberate failure path in chaos/, scrub/, utils/retry.py and
+ops/fallback.py raises one of them, so consumers can distinguish
+"retry this" (TransientBackendError) from "this read set cannot be
+decoded, here is exactly what is lost" (UnrecoverableError) without
+string matching.  docs/ROBUSTNESS.md has the full taxonomy table.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+
+class CephTpuError(Exception):
+    """Base of every structured error this framework raises on
+    purpose (plain ValueError/IOError remain for argument validation
+    and the reference-mirrored plugin contracts)."""
+
+
+class TransientBackendError(CephTpuError):
+    """A backend/device/store operation failed in a way expected to
+    succeed on retry (the -EAGAIN/-EIO-on-flaky-media class).  The
+    retryable type for utils/retry.py; chaos injects these."""
+
+
+class RetryExhausted(CephTpuError):
+    """retry_call gave up: every attempt raised a retryable error.
+
+    The last underlying error is chained as ``__cause__`` and kept as
+    ``.last``; ``.attempts`` records how many tries ran.
+    """
+
+    def __init__(self, attempts: int, last: BaseException) -> None:
+        super().__init__(
+            f"retry exhausted after {attempts} attempts: "
+            f"{type(last).__name__}: {last}")
+        self.attempts = attempts
+        self.last = last
+
+
+class ScrubError(CephTpuError):
+    """A scrub/repair invariant failed (repair produced bytes that do
+    not re-verify, a store write-back failed, ...).  ``.shards`` names
+    the shard ids involved when known."""
+
+    def __init__(self, msg: str,
+                 shards: Iterable[int] = ()) -> None:
+        self.shards: Tuple[int, ...] = tuple(sorted(shards))
+        if self.shards:
+            msg = f"{msg} (shards {list(self.shards)})"
+        super().__init__(msg)
+
+
+class UnrecoverableError(ScrubError):
+    """More shards are lost/corrupt than the code can reconstruct.
+
+    Raised INSTEAD of returning garbage bytes.  Structured fields:
+
+    - ``shards``  — every shard id classified missing or corrupt,
+    - ``extents`` — the logical (offset, length) byte ranges of the
+      object that cannot be reconstructed (lost DATA chunks only;
+      parity loss costs durability, not client bytes), merged where
+      adjacent.  Empty when the geometry is unknown to the caller.
+    """
+
+    def __init__(self, msg: str, shards: Iterable[int],
+                 extents: Sequence[Tuple[int, int]] = (),
+                 cause: Optional[BaseException] = None) -> None:
+        self.extents: Tuple[Tuple[int, int], ...] = tuple(extents)
+        detail = msg
+        if self.extents:
+            ext = ", ".join(f"[{o}, +{n})" for o, n in self.extents[:8])
+            more = ("" if len(self.extents) <= 8
+                    else f" and {len(self.extents) - 8} more")
+            detail = f"{msg}; unrecoverable extents: {ext}{more}"
+        super().__init__(detail, shards)
+        if cause is not None:
+            self.__cause__ = cause

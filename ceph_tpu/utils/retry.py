@@ -1,0 +1,117 @@
+"""Bounded retry with exponential backoff — the transient-error shield.
+
+The reference retries flaky backend ops at several layers (ObjectStore
+EIO retry policy, messenger reconnect backoff in msg/async, the osd's
+`osd_op_queue` requeue on EAGAIN).  Here one primitive covers the
+framework's needs: ``retry_call`` runs a callable, retries only the
+exception types the policy names (default: TransientBackendError),
+sleeps an exponentially growing, capped delay between attempts, and
+raises RetryExhausted — with the last error chained — when the budget
+is spent.
+
+The clock is injectable: tests pass ``FakeClock`` and assert the exact
+backoff schedule with ZERO real sleeping (the no-real-sleeps rule for
+the chaos/scrub suites); production uses the module default
+``SystemClock``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple, Type
+
+from .errors import RetryExhausted, TransientBackendError
+
+
+class SystemClock:
+    """Real time: the production clock."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class FakeClock:
+    """Deterministic test clock: sleep() just advances ``now`` and
+    records the request, so retry schedules are asserted exactly and
+    instantly."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self.now = start
+        self.sleeps: List[float] = []
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """attempts total tries; delay(i) = min(base * multiplier^i, max)
+    after failed attempt i (no delay after the final failure)."""
+
+    attempts: int = 3
+    base_delay: float = 0.01
+    multiplier: float = 2.0
+    max_delay: float = 1.0
+    retry_on: Tuple[Type[BaseException], ...] = (TransientBackendError,)
+
+    def __post_init__(self) -> None:
+        if self.attempts < 1:
+            raise ValueError(f"attempts={self.attempts} must be >= 1")
+        if self.base_delay < 0 or self.max_delay < 0:
+            raise ValueError("delays must be >= 0")
+
+    def delay(self, failed_attempt: int) -> float:
+        return min(self.base_delay * self.multiplier ** failed_attempt,
+                   self.max_delay)
+
+
+@dataclass
+class RetryStats:
+    """Mutable per-call record (handed to on_retry and kept by
+    callers that want the schedule for reports)."""
+
+    attempts: int = 0
+    delays: List[float] = field(default_factory=list)
+
+
+def retry_call(fn: Callable, *args,
+               policy: Optional[RetryPolicy] = None,
+               clock=None,
+               on_retry: Optional[Callable] = None,
+               stats: Optional[RetryStats] = None,
+               **kwargs):
+    """Run ``fn(*args, **kwargs)`` under ``policy``.
+
+    Retries only ``policy.retry_on`` exceptions; anything else
+    propagates on the first raise (a corrupt shard is not a flaky
+    read).  ``on_retry(attempt_index, delay, error)`` fires before
+    each backoff sleep.  Raises RetryExhausted(attempts, last) when
+    every attempt failed.
+    """
+    policy = policy or RetryPolicy()
+    clock = clock or SystemClock()
+    last: Optional[BaseException] = None
+    for attempt in range(policy.attempts):
+        if stats is not None:
+            stats.attempts = attempt + 1
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            last = e
+            if attempt + 1 >= policy.attempts:
+                break
+            d = policy.delay(attempt)
+            if stats is not None:
+                stats.delays.append(d)
+            if on_retry is not None:
+                on_retry(attempt, d, e)
+            clock.sleep(d)
+    raise RetryExhausted(policy.attempts, last) from last

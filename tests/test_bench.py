@@ -135,6 +135,59 @@ def test_decode_loop_mode(plugin, profile):
     assert res["total_bytes"] > 0 and res["gbps"] > 0
 
 
+def test_degraded_workload_scrub_and_repair():
+    """--workload degraded: the recovery-path row (deep_scrub verify +
+    classify + repair) with erasures AND a corruption."""
+    res = run_bench(["--plugin", "jerasure",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--batch", "2",
+                     "--iterations", "2", "--workload", "degraded",
+                     "--erasures", "1", "--corruptions", "1",
+                     "--device", "host"])
+    assert res["workload"] == "degraded"
+    assert res["erasures"] == 1 and res["corruptions"] == 1
+    assert res["gbps"] > 0
+    # total bytes = logical object bytes per iteration
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"k": "4", "m": "2"})
+    assert res["total_bytes"] == 2 * 2 * 4 * ec.get_chunk_size(4096)
+
+
+def test_degraded_workload_pure_scrub():
+    """-e 0 with no corruptions times the verify-only deep scrub."""
+    res = run_bench(["--plugin", "jerasure",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--batch", "2",
+                     "--iterations", "1", "--workload", "degraded",
+                     "--erasures", "0", "--device", "host"])
+    assert res["workload"] == "degraded" and res["gbps"] > 0
+
+
+def test_degraded_workload_rejects_over_budget_args():
+    with pytest.raises(ValueError, match="clean shards"):
+        run_bench(["--plugin", "jerasure",
+                   "--parameter", "k=2", "--parameter", "m=1",
+                   "--size", "4096", "--workload", "degraded",
+                   "--erasures", "2", "--corruptions", "1",
+                   "--device", "host"])
+
+
+def test_bench_degraded_rows_config():
+    """bench.py's recovery rows stay within the failure budget and
+    cover 0 / 1 / m-combined fault levels."""
+    import bench
+    names = [n for n, _ in bench.DEGRADED_ROWS]
+    assert names == ["rs_k8_m3_scrub_e0", "rs_k8_m3_degraded_e1",
+                     "rs_k8_m3_degraded_e2_c1"]
+    for _, extra in bench.DEGRADED_ROWS:
+        args = bench.DEGRADED_COMMON + ["--iterations", "1"] + extra
+        b = ErasureCodeBench()
+        b.setup(args)                  # parses cleanly
+        e = b.args.erasures + b.args.corruptions
+        assert e <= 3                  # m=3 budget
+
+
 def test_bench_last_good_roundtrip(tmp_path, monkeypatch):
     """bench.py persists every successful device line to
     BENCH_LAST_GOOD.json and embeds it in the tunnel-down error line —

@@ -1,0 +1,126 @@
+"""chaos/ — deterministic fault injection over the ShardStore."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.chaos import (
+    BitFlip,
+    Compose,
+    ShardErasure,
+    ShardStore,
+    TransientErrors,
+    Truncate,
+    ZeroStripe,
+    damaged_shards,
+    inject,
+    random_injectors,
+)
+from ceph_tpu.utils.errors import TransientBackendError
+
+CHUNK = 256
+N_STRIPES = 4
+
+
+def make_shards(n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return {s: rng.integers(0, 256, size=CHUNK * N_STRIPES,
+                            dtype=np.uint8).tobytes()
+            for s in range(n)}
+
+
+def test_erasure_deletes_exactly_the_target():
+    shards = make_shards()
+    store, faults = inject(shards, [ShardErasure(shards=[3])], seed=1)
+    assert store.shard_ids() == [0, 1, 2, 4, 5]
+    assert [(f.kind, f.shard) for f in faults] == [("erase", 3)]
+
+
+def test_bitflip_changes_exactly_one_bit():
+    shards = make_shards()
+    store, faults = inject(shards, [BitFlip(shards=[2], flips=1)],
+                           seed=2)
+    (f,) = faults
+    assert f.kind == "bitflip" and f.shard == 2
+    a = np.frombuffer(shards[2], np.uint8)
+    b = np.frombuffer(store.read(2), np.uint8)
+    diff = a ^ b
+    assert int(np.unpackbits(diff).sum()) == 1
+    assert int(np.nonzero(diff)[0][0]) == f.offset
+    # everything else untouched
+    for s in (0, 1, 3, 4, 5):
+        assert store.read(s) == shards[s]
+
+
+def test_truncate_cuts_to_keep():
+    shards = make_shards()
+    store, faults = inject(shards, [Truncate(shard=1, keep=100)], seed=3)
+    (f,) = faults
+    assert f.kind == "truncate" and f.shard == 1
+    assert store.read(1) == shards[1][:100]
+
+
+def test_zero_stripe_zeroes_one_chunk_of_every_shard():
+    shards = make_shards()
+    store, faults = inject(shards, [ZeroStripe(stripe=2)], seed=4,
+                           chunk_size=CHUNK)
+    assert len(faults) == len(shards)
+    for s, orig in shards.items():
+        got = store.read(s)
+        assert got[2 * CHUNK:3 * CHUNK] == b"\x00" * CHUNK
+        assert got[:2 * CHUNK] == orig[:2 * CHUNK]
+        assert got[3 * CHUNK:] == orig[3 * CHUNK:]
+
+
+def test_zero_stripe_requires_chunk_size():
+    store = ShardStore(make_shards())
+    with pytest.raises(ValueError):
+        ZeroStripe(stripe=0).apply(store, np.random.default_rng(0))
+
+
+def test_transient_errors_then_clean_reads():
+    shards = make_shards()
+    store, faults = inject(shards,
+                           [TransientErrors(shards=[4], count=2)], seed=5)
+    (f,) = faults
+    assert f.kind == "transient" and not f.damages_data
+    with pytest.raises(TransientBackendError):
+        store.read(4)
+    with pytest.raises(TransientBackendError):
+        store.read(4)
+    assert store.read(4) == shards[4]       # bytes undamaged
+    assert store.transient_failures == 2
+
+
+def test_seed_determinism_and_divergence():
+    injectors = [ShardErasure(n=1), BitFlip(n=2, flips=2), Truncate()]
+    s1, f1 = inject(make_shards(), injectors, seed=77)
+    s2, f2 = inject(make_shards(), injectors, seed=77)
+    assert f1 == f2
+    assert s1.snapshot() == s2.snapshot()
+    s3, f3 = inject(make_shards(), injectors, seed=78)
+    assert s3.snapshot() != s1.snapshot()
+
+
+def test_compose_applies_in_order():
+    shards = make_shards()
+    comp = Compose((ShardErasure(shards=[0]), Truncate(shard=1, keep=8)))
+    store, faults = inject(shards, [comp], seed=6)
+    assert [f.kind for f in faults] == ["erase", "truncate"]
+    assert 0 not in store.shards and len(store.shards[1]) == 8
+
+
+def test_damaged_shards_excludes_transient():
+    shards = make_shards()
+    _, faults = inject(shards, [ShardErasure(shards=[5]),
+                                TransientErrors(shards=[1], count=1)],
+                       seed=8)
+    assert damaged_shards(faults) == [5]
+
+
+def test_random_injectors_replayable():
+    rng = np.random.default_rng(123)
+    injs = random_injectors(rng, 3)
+    s1, f1 = inject(make_shards(), injs, seed=9)
+    s2, f2 = inject(make_shards(), injs, seed=9)
+    assert f1 == f2 and s1.snapshot() == s2.snapshot()
+    assert len(f1) >= 1

@@ -1,0 +1,154 @@
+"""Error-path coverage: the failure branches must raise CLEARLY, never
+return garbage bytes.
+
+- stripe.encode/decode argument-validation ValueError branches,
+- decode with insufficient chunks raises (IOError) for EVERY plugin
+  family — jerasure, isa, shec, clay, lrc — through all three decode
+  surfaces (minimum_to_decode, the byte-dict decode API, and
+  decode_chunks_batch)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+from ceph_tpu.codes.stripe import StripeInfo, decode, encode, read
+
+PLUGINS = [
+    ("jerasure_rs", "jerasure",
+     {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("jerasure_cauchy", "jerasure",
+     {"technique": "cauchy_good", "k": "4", "m": "2",
+      "packetsize": "32"}),
+    ("isa", "isa", {"k": "4", "m": "2"}),
+    ("shec", "shec", {"k": "4", "m": "3", "c": "2"}),
+    ("clay", "clay", {"k": "4", "m": "2", "d": "5"}),
+    ("lrc", "lrc", {"k": "4", "l": "3", "m": "2"}),
+]
+IDS = [p[0] for p in PLUGINS]
+
+
+def factory(plugin, profile):
+    return ErasureCodePluginRegistry.instance().factory(plugin,
+                                                        dict(profile))
+
+
+def rs_fixture():
+    ec = factory("jerasure", {"technique": "reed_sol_van",
+                              "k": "4", "m": "2"})
+    width = 4 * ec.get_chunk_size(4 * 512)
+    return ec, StripeInfo(4, width)
+
+
+# -- stripe.encode ValueError branches ----------------------------------
+
+def test_encode_rejects_misaligned_input():
+    ec, sinfo = rs_fixture()
+    with pytest.raises(ValueError, match="stripe-width aligned"):
+        encode(sinfo, ec, b"x" * (sinfo.stripe_width + 1))
+
+
+def test_encode_rejects_mismatched_stripe_info():
+    ec, sinfo = rs_fixture()
+    bad = StripeInfo(2, sinfo.stripe_width)     # k=2 != code's k=4
+    with pytest.raises(ValueError, match="does not match"):
+        encode(bad, ec, b"x" * sinfo.stripe_width)
+
+
+def test_stripe_info_rejects_indivisible_width():
+    with pytest.raises(ValueError, match="divide"):
+        StripeInfo(3, 1024)
+
+
+# -- stripe.decode ValueError branches ----------------------------------
+
+def test_decode_rejects_uneven_shard_buffers():
+    ec, sinfo = rs_fixture()
+    shards = encode(sinfo, ec, b"\x07" * sinfo.stripe_width)
+    shards[1] = shards[1][:-8]
+    with pytest.raises(ValueError, match="uneven"):
+        decode(sinfo, ec, shards, {0})
+
+
+def test_decode_rejects_unaligned_shard_length():
+    ec, sinfo = rs_fixture()
+    bad = {s: b"z" * (sinfo.chunk_size + 1) for s in range(6)}
+    with pytest.raises(ValueError, match="chunk-aligned"):
+        decode(sinfo, ec, bad, {0})
+
+
+def test_read_rejects_extent_outside_object():
+    ec, sinfo = rs_fixture()
+    shards = encode(sinfo, ec, b"\x07" * sinfo.stripe_width)
+    with pytest.raises(ValueError, match="outside"):
+        read(sinfo, ec, shards, 0, sinfo.stripe_width + 1)
+    with pytest.raises(ValueError, match="outside"):
+        read(sinfo, ec, shards, -1, 4)
+
+
+# -- insufficient chunks: every plugin, every decode surface -------------
+
+def insufficient_split(ec):
+    """(available, wanted): keep k-1 survivors INCLUDING no full
+    recovery set — every parity erased plus enough data that no code
+    family can reconstruct the wanted chunk."""
+    k = ec.get_data_chunk_count()
+    n = ec.get_chunk_count()
+    from ceph_tpu.codes.stripe import _chunk_mapping
+    mapping = _chunk_mapping(ec)
+    data_shards = [mapping[c] for c in range(k)]
+    # survivors: k-2 data shards only (all parity gone, 2 data gone)
+    available = set(data_shards[2:])
+    want = {data_shards[0]}
+    return available, want
+
+
+@pytest.mark.parametrize("name,plugin,profile", PLUGINS, ids=IDS)
+def test_minimum_to_decode_raises_when_insufficient(name, plugin,
+                                                    profile):
+    ec = factory(plugin, profile)
+    available, want = insufficient_split(ec)
+    with pytest.raises((IOError, ValueError)):
+        ec.minimum_to_decode(want, available)
+
+
+@pytest.mark.parametrize("name,plugin,profile", PLUGINS, ids=IDS)
+def test_decode_bytes_api_raises_never_garbage(name, plugin, profile):
+    ec = factory(plugin, profile)
+    n = ec.get_chunk_count()
+    chunk_size = ec.get_chunk_size(ec.get_data_chunk_count() * 512)
+    rng = np.random.default_rng(3)
+    full = {s: rng.integers(0, 256, chunk_size, np.uint8).tobytes()
+            for s in range(n)}
+    available, want = insufficient_split(ec)
+    chunks = {s: full[s] for s in available}
+    with pytest.raises((IOError, ValueError)):
+        ec.decode(set(want), chunks, chunk_size)
+
+
+@pytest.mark.parametrize("name,plugin,profile", PLUGINS, ids=IDS)
+def test_decode_chunks_batch_raises_when_insufficient(name, plugin,
+                                                      profile):
+    ec = factory(plugin, profile)
+    chunk_size = ec.get_chunk_size(ec.get_data_chunk_count() * 512)
+    available, want = insufficient_split(ec)
+    avail = tuple(sorted(available))
+    stack = np.zeros((2, len(avail), chunk_size), np.uint8)
+    with pytest.raises((IOError, ValueError)):
+        ec.decode_chunks_batch(stack, avail, tuple(sorted(want)))
+
+
+@pytest.mark.parametrize("name,plugin,profile", PLUGINS, ids=IDS)
+def test_stripe_decode_raises_when_insufficient(name, plugin, profile):
+    """The whole-object path: stripe.decode must surface the plugin's
+    error, not fabricate bytes."""
+    ec = factory(plugin, profile)
+    k = ec.get_data_chunk_count()
+    sinfo = StripeInfo(k, k * ec.get_chunk_size(k * 512))
+    rng = np.random.default_rng(4)
+    obj = rng.integers(0, 256, sinfo.stripe_width * 2,
+                       np.uint8).tobytes()
+    shards = encode(sinfo, ec, obj)
+    available, want = insufficient_split(ec)
+    survivors = {s: shards[s] for s in available}
+    with pytest.raises((IOError, ValueError)):
+        decode(sinfo, ec, survivors, want)

@@ -1,0 +1,123 @@
+"""ops/fallback.py — the explicit Pallas → XLA → numpy policy that
+replaced the silent bare-except backend probe in ops/pallas_gf.py."""
+
+import pytest
+
+from ceph_tpu.ops import fallback, pallas_gf
+from ceph_tpu.ops.fallback import (
+    NO_BACKEND,
+    FallbackPolicy,
+    global_policy,
+    set_global_policy,
+)
+
+
+def test_kind_to_engine_ladder():
+    p = FallbackPolicy(force=None)
+    assert p.engine("tpu") == "pallas"
+    assert p.engine("cpu") == "xla"
+    assert p.engine("gpu") == "xla"
+    assert p.engine(NO_BACKEND) == "numpy"
+
+
+def test_probe_catches_only_backend_init_errors(monkeypatch):
+    import jax
+    p = FallbackPolicy(force=None)
+    monkeypatch.setattr(jax, "default_backend",
+                        lambda: (_ for _ in ()).throw(
+                            RuntimeError("no platform")))
+    assert p.device_kind() == NO_BACKEND
+    assert isinstance(p.probe_error, RuntimeError)
+    assert p.engine() == "numpy"
+
+    # anything OTHER than a backend-init failure must propagate — the
+    # old bare `except Exception` swallowed these
+    p2 = FallbackPolicy(force=None)
+    monkeypatch.setattr(jax, "default_backend",
+                        lambda: (_ for _ in ()).throw(
+                            KeyError("unrelated bug")))
+    with pytest.raises(KeyError):
+        p2.device_kind()
+
+
+def test_probe_result_is_cached(monkeypatch):
+    import jax
+    calls = []
+    p = FallbackPolicy(force=None)
+    monkeypatch.setattr(jax, "default_backend",
+                        lambda: (calls.append(1), "cpu")[1])
+    assert p.device_kind() == "cpu"
+    assert p.device_kind() == "cpu"
+    assert calls == [1]
+
+
+def test_force_override_wins():
+    p = FallbackPolicy(force="numpy")
+    assert p.engine("tpu") == "numpy"
+    with pytest.raises(ValueError):
+        FallbackPolicy(force="cuda")
+
+
+def test_env_force(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_ENGINE", "xla")
+    assert FallbackPolicy().engine("tpu") == "xla"
+    monkeypatch.delenv("CEPH_TPU_ENGINE")
+    assert FallbackPolicy().engine("tpu") == "pallas"
+
+
+def test_selection_logged_exactly_once(monkeypatch):
+    from ceph_tpu.utils import log as log_mod
+    lines = []
+    monkeypatch.setattr(log_mod, "dout",
+                        lambda sub, lvl, msg: lines.append(msg))
+    monkeypatch.setattr(fallback, "dout",
+                        lambda sub, lvl, msg: lines.append(msg))
+    p = FallbackPolicy(force=None)
+    for _ in range(3):
+        p.engine("cpu")
+    assert len(lines) == 1 and "engine=xla" in lines[0]
+    p.engine("tpu")           # a DIFFERENT outcome logs again
+    assert len(lines) == 2 and "engine=pallas" in lines[1]
+
+
+def test_use_pallas_routes_through_policy(monkeypatch):
+    # the monkeypatch seam tests/test_mxu.py relies on must keep working
+    monkeypatch.setattr(pallas_gf, "_device_kind", lambda: "tpu")
+    assert pallas_gf.use_pallas()
+    monkeypatch.setattr(pallas_gf, "_device_kind", lambda: "cpu")
+    assert not pallas_gf.use_pallas()
+
+
+def test_numpy_tier_pins_host_path(monkeypatch):
+    """With the policy forced to numpy, the mixin batched paths must
+    run the numpy reference ops even ABOVE min_xla_bytes (the
+    no-XLA-backend degradation)."""
+    import numpy as np
+
+    from ceph_tpu.codes import techniques
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    prev = set_global_policy(FallbackPolicy(force="numpy"))
+    try:
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jerasure", {"technique": "reed_sol_van",
+                         "k": "2", "m": "1"})
+        ec.min_xla_bytes = 1          # everything would go to XLA
+        called = []
+        monkeypatch.setattr(
+            techniques, "apply_matrix_best",
+            lambda *a, **k: called.append(1))
+        data = np.arange(2 * 64, dtype=np.uint8).reshape(1, 2, 64)
+        parity = ec.encode_chunks_batch(data)
+        assert called == []           # device path never dispatched
+        assert parity.shape == (1, 1, 64)
+        # numpy tier output is the ground truth itself
+        from ceph_tpu.ops import regionops
+        ref = regionops.matrix_encode(data, ec.matrix, 8)
+        assert np.array_equal(parity, ref)
+    finally:
+        set_global_policy(prev)
+
+
+def test_global_policy_is_process_wide():
+    a = global_policy()
+    assert global_policy() is a

@@ -1,0 +1,99 @@
+"""utils/retry.py — bounded retry/backoff with the injectable clock.
+
+Every test runs on FakeClock: the FULL backoff schedule is asserted
+with zero real sleeping (the no-real-sleeps rule for the robustness
+suites)."""
+
+import pytest
+
+from ceph_tpu.utils.errors import RetryExhausted, TransientBackendError
+from ceph_tpu.utils.retry import (
+    FakeClock,
+    RetryPolicy,
+    RetryStats,
+    retry_call,
+)
+
+
+class Flaky:
+    """Fails with ``exc`` the first ``n`` calls, then returns 'ok'."""
+
+    def __init__(self, n, exc=TransientBackendError):
+        self.n = n
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.n:
+            raise self.exc(f"boom {self.calls}")
+        return "ok"
+
+
+def test_succeeds_after_transient_failures_with_exact_backoff():
+    clock = FakeClock()
+    fn = Flaky(2)
+    out = retry_call(fn, policy=RetryPolicy(attempts=4, base_delay=0.01,
+                                            multiplier=2.0),
+                     clock=clock)
+    assert out == "ok" and fn.calls == 3
+    # exponential: 0.01 after attempt 0, 0.02 after attempt 1, no
+    # sleep once the call succeeds
+    assert clock.sleeps == [0.01, 0.02]
+    assert clock.now == pytest.approx(0.03)
+
+
+def test_max_delay_caps_the_schedule():
+    clock = FakeClock()
+    fn = Flaky(4)
+    retry_call(fn, policy=RetryPolicy(attempts=5, base_delay=0.1,
+                                      multiplier=10.0, max_delay=0.5),
+               clock=clock)
+    assert clock.sleeps == [0.1, 0.5, 0.5, 0.5]
+
+
+def test_exhaustion_raises_structured_error_with_cause():
+    clock = FakeClock()
+    fn = Flaky(99)
+    with pytest.raises(RetryExhausted) as ei:
+        retry_call(fn, policy=RetryPolicy(attempts=3), clock=clock)
+    assert fn.calls == 3
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, TransientBackendError)
+    assert ei.value.__cause__ is ei.value.last
+    assert "boom 3" in str(ei.value)
+    # 3 attempts => 2 backoff sleeps, none after the final failure
+    assert len(clock.sleeps) == 2
+
+
+def test_non_retryable_errors_propagate_immediately():
+    clock = FakeClock()
+    fn = Flaky(1, exc=ValueError)
+    with pytest.raises(ValueError):
+        retry_call(fn, policy=RetryPolicy(attempts=5), clock=clock)
+    assert fn.calls == 1 and clock.sleeps == []
+
+
+def test_on_retry_and_stats_observe_the_schedule():
+    clock = FakeClock()
+    seen = []
+    stats = RetryStats()
+    retry_call(Flaky(2),
+               policy=RetryPolicy(attempts=4),
+               clock=clock, stats=stats,
+               on_retry=lambda i, d, e: seen.append((i, d, str(e))))
+    assert [(i, d) for i, d, _ in seen] == [(0, 0.01), (1, 0.02)]
+    assert stats.attempts == 3 and stats.delays == [0.01, 0.02]
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(base_delay=-1)
+
+
+def test_args_pass_through():
+    clock = FakeClock()
+    assert retry_call(lambda a, b=0: a + b, 2, b=3,
+                      clock=clock) == 5

@@ -1,0 +1,296 @@
+"""scrub/ — deep-scrub classification, repair verification, OSD
+feedback, degraded reads, and the vectorized batch CRC."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.chaos import (
+    BitFlip,
+    ShardErasure,
+    TransientErrors,
+    Truncate,
+    ZeroStripe,
+    inject,
+)
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+from ceph_tpu.codes.stripe import (
+    HashInfo,
+    StripeInfo,
+    ceph_crc32c,
+    ceph_crc32c_batch,
+    encode,
+)
+from ceph_tpu.crush import (
+    CrushBuilder,
+    step_chooseleaf_indep,
+    step_emit,
+    step_take,
+)
+from ceph_tpu.crush.osdmap import OSDMap, PGPool
+from ceph_tpu.scrub import (
+    ScrubError,
+    ShardState,
+    UnrecoverableError,
+    apply_osd_feedback,
+    deep_scrub,
+    read_degraded,
+    repair,
+    scrub_and_repair,
+    unrecoverable_extents,
+)
+from ceph_tpu.utils.retry import FakeClock, RetryPolicy
+
+K, M = 4, 2
+N = K + M
+N_STRIPES = 4
+
+
+def make_object(k=K, m=M, stripes=N_STRIPES, seed=0, size=1024):
+    reg = ErasureCodePluginRegistry.instance()
+    ec = reg.factory("jerasure", {"technique": "reed_sol_van",
+                                  "k": str(k), "m": str(m)})
+    width = k * ec.get_chunk_size(k * size)
+    sinfo = StripeInfo(k, width)
+    rng = np.random.default_rng(seed)
+    obj = rng.integers(0, 256, size=width * stripes,
+                       dtype=np.uint8).tobytes()
+    shards = encode(sinfo, ec, obj)
+    hinfo = HashInfo(k + m)
+    hinfo.append(0, shards)
+    return ec, sinfo, obj, shards, hinfo
+
+
+# -- batch CRC ----------------------------------------------------------
+
+@pytest.mark.parametrize("length", [0, 1, 100, 4096, 8192, 8192 + 37,
+                                    3 * 4096 + 1])
+def test_crc_batch_matches_scalar(length):
+    rng = np.random.default_rng(length)
+    rows = rng.integers(0, 256, size=(5, length), dtype=np.uint8)
+    seeds = [0xFFFFFFFF, 0, 1, 0xDEADBEEF, 12345]
+    got = ceph_crc32c_batch(seeds, rows)
+    want = [ceph_crc32c(seeds[i], rows[i].tobytes()) for i in range(5)]
+    assert got.tolist() == want
+
+
+def test_crc_batch_validates_shape():
+    with pytest.raises(ValueError):
+        ceph_crc32c_batch([0], np.zeros(8, np.uint8))
+    with pytest.raises(ValueError):
+        ceph_crc32c_batch([0, 0], np.zeros((1, 8), np.uint8))
+
+
+# -- deep scrub ---------------------------------------------------------
+
+def test_clean_object_scrubs_clean():
+    ec, sinfo, _, shards, hinfo = make_object()
+    report = deep_scrub(sinfo, ec, dict(shards), hinfo)
+    assert report.is_clean
+    assert report.clean == list(range(N))
+    assert all(v.state is ShardState.CLEAN
+               for v in report.verdicts.values())
+    # zero false positives is the acceptance bar
+    assert report.missing == [] and report.corrupt == []
+
+
+def test_scrub_classifies_every_fault_kind():
+    ec, sinfo, _, shards, hinfo = make_object()
+    store, _ = inject(shards, [ShardErasure(shards=[0]),
+                               BitFlip(shards=[2], flips=1),
+                               Truncate(shard=4, keep=17)],
+                      seed=5, chunk_size=sinfo.chunk_size)
+    report = deep_scrub(sinfo, ec, store, hinfo)
+    assert report.missing == [0]
+    assert report.corrupt == [2, 4]
+    assert report.clean == [1, 3, 5]
+    v4 = report.verdicts[4]
+    assert v4.length == 17 and "length" in v4.error
+    assert report.verdicts[2].error == "crc mismatch"
+
+
+def test_scrub_retries_transient_errors_without_sleeping():
+    ec, sinfo, _, shards, hinfo = make_object()
+    store, _ = inject(shards, [TransientErrors(shards=[1], count=2)],
+                      seed=6, chunk_size=sinfo.chunk_size)
+    clock = FakeClock()
+    report = deep_scrub(sinfo, ec, store, hinfo,
+                        retry_policy=RetryPolicy(attempts=4),
+                        clock=clock)
+    assert report.is_clean and report.retried_shards == (1,)
+    assert clock.sleeps == [0.01, 0.02]     # fake time only
+
+
+def test_scrub_exhausted_retries_classify_missing():
+    ec, sinfo, _, shards, hinfo = make_object()
+    store, _ = inject(shards, [TransientErrors(shards=[3], count=10)],
+                      seed=7, chunk_size=sinfo.chunk_size)
+    report = deep_scrub(sinfo, ec, store, hinfo,
+                        retry_policy=RetryPolicy(attempts=2),
+                        clock=FakeClock())
+    assert report.missing == [3]
+    assert "retry exhausted" in report.verdicts[3].error
+
+
+# -- repair -------------------------------------------------------------
+
+def test_repair_heals_mixed_faults_byte_identically():
+    ec, sinfo, _, shards, hinfo = make_object()
+    store, _ = inject(shards, [ShardErasure(shards=[5]),
+                               BitFlip(shards=[1], flips=3)],
+                      seed=8, chunk_size=sinfo.chunk_size)
+    rep = repair(sinfo, ec, store, hinfo)
+    assert sorted(rep.repaired) == [1, 5]
+    assert rep.reencode_verified and rep.crc_verified
+    assert store.snapshot() == shards       # byte-identical heal
+    # and the healed store scrubs clean
+    assert deep_scrub(sinfo, ec, store, hinfo).is_clean
+
+
+def test_repair_full_budget_m_faults():
+    ec, sinfo, _, shards, hinfo = make_object()
+    store, _ = inject(shards, [ShardErasure(shards=[0]),
+                               Truncate(shard=3, keep=0)],
+                      seed=9, chunk_size=sinfo.chunk_size)
+    rep = repair(sinfo, ec, store, hinfo)
+    assert sorted(rep.repaired) == [0, 3]
+    assert store.snapshot() == shards
+
+
+def test_repair_clean_object_is_a_noop():
+    ec, sinfo, _, shards, hinfo = make_object()
+    store, _ = inject(shards, [], seed=1, chunk_size=sinfo.chunk_size)
+    rep = repair(sinfo, ec, store, hinfo)
+    assert rep.repaired == {} and rep.scrub.is_clean
+
+
+def test_over_budget_raises_structured_unrecoverable():
+    ec, sinfo, obj, shards, hinfo = make_object()
+    store, _ = inject(shards, [ShardErasure(shards=[0, 1]),
+                               BitFlip(shards=[2], flips=1)],
+                      seed=10, chunk_size=sinfo.chunk_size)
+    with pytest.raises(UnrecoverableError) as ei:
+        repair(sinfo, ec, store, hinfo)
+    e = ei.value
+    assert e.shards == (0, 1, 2)
+    # extents cover exactly the lost DATA chunks (0, 1, 2 of every
+    # stripe — adjacent, so merged to one span per stripe)
+    cs, width = sinfo.chunk_size, sinfo.stripe_width
+    want = tuple((s * width, 3 * cs) for s in range(N_STRIPES))
+    assert e.extents == want
+    # and the store was NOT silently half-written
+    assert 0 not in store.shards and 1 not in store.shards
+
+
+def test_unrecoverable_extents_parity_only_is_empty():
+    ec, sinfo, _, shards, hinfo = make_object()
+    # parity shards carry no client bytes
+    assert unrecoverable_extents(sinfo, ec, [4, 5], N_STRIPES) == ()
+
+
+def test_repair_refuses_on_stale_hashinfo():
+    """A HashInfo that no longer matches the object (metadata
+    corruption) must fail the crc gate, not write back."""
+    ec, sinfo, _, shards, hinfo = make_object()
+    bad_hinfo = HashInfo(N)
+    bad_hinfo.append(0, shards)
+    bad_hinfo.cumulative_shard_hashes[3] ^= 0x1     # poison one digest
+    store, _ = inject(shards, [ShardErasure(shards=[0])], seed=11,
+                      chunk_size=sinfo.chunk_size)
+    with pytest.raises(ScrubError):
+        repair(sinfo, ec, store, bad_hinfo)
+
+
+# -- degraded read ------------------------------------------------------
+
+def test_read_degraded_serves_bytes_under_budget():
+    ec, sinfo, obj, shards, hinfo = make_object()
+    store, _ = inject(shards, [ShardErasure(shards=[2]),
+                               BitFlip(shards=[0], flips=1)],
+                      seed=12, chunk_size=sinfo.chunk_size)
+    got = read_degraded(sinfo, ec, store, hinfo, 100, 6000)
+    assert got == obj[100:6100]
+
+
+def test_read_degraded_never_returns_garbage():
+    ec, sinfo, obj, shards, hinfo = make_object()
+    store, _ = inject(shards, [ShardErasure(shards=[0, 1]),
+                               BitFlip(shards=[2], flips=1)],
+                      seed=13, chunk_size=sinfo.chunk_size)
+    off, ln = 0, sinfo.stripe_width
+    with pytest.raises(UnrecoverableError) as ei:
+        read_degraded(sinfo, ec, store, hinfo, off, ln)
+    # extents clipped to the requested window: chunks 0-2 of stripe 0
+    assert ei.value.extents == ((0, 3 * sinfo.chunk_size),)
+
+
+# -- OSD feedback / remap ----------------------------------------------
+
+def build_cluster(n_hosts=8, devs=2):
+    b = CrushBuilder()
+    root = b.build_two_level(n_hosts, devs)
+    b.add_rule(0, [step_take(root),
+                   step_chooseleaf_indep(N, b.type_id("host")),
+                   step_emit()])
+    osdmap = OSDMap(crush=b.map)
+    osdmap.pools[2] = PGPool(pool_id=2, pg_num=16, size=N, erasure=True)
+    return osdmap
+
+
+def test_osd_feedback_marks_and_remaps():
+    from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+    osdmap = build_cluster()
+    ps = 3
+    _, _, acting, _ = osdmap.pg_to_up_acting_osds(2, ps)
+    remap = apply_osd_feedback(osdmap, 2, ps, acting, bad_shards=[1, 4])
+    assert remap.marked_osds == (acting[1], acting[4])
+    for osd in remap.marked_osds:
+        assert not osdmap.is_up(osd) and osdmap.is_out(osd)
+    live = [o for o in remap.new_acting if o != CRUSH_ITEM_NONE]
+    assert not set(remap.marked_osds) & set(live)
+    # the damaged slots moved somewhere new
+    assert set(remap.moved) >= {1, 4}
+
+
+def test_scrub_and_repair_closes_the_loop():
+    """End to end: damage -> scrub -> repair -> remap -> the repaired
+    shards land on the NEW acting set and the object reads back."""
+    ec, sinfo, obj, shards, hinfo = make_object()
+    osdmap = build_cluster()
+    ps = 5
+    _, _, acting, _ = osdmap.pg_to_up_acting_osds(2, ps)
+    store, _ = inject(shards, [ShardErasure(shards=[3]),
+                               BitFlip(shards=[5], flips=1)],
+                      seed=14, chunk_size=sinfo.chunk_size)
+    rep, remap = scrub_and_repair(sinfo, ec, store, hinfo,
+                                  osdmap=osdmap, pool_id=2, ps=ps,
+                                  acting=acting)
+    assert store.snapshot() == shards
+    assert remap is not None
+    assert remap.marked_osds == (acting[3], acting[5])
+    assert set(remap.moved) >= {3, 5}
+    # client read over the healed store reassembles byte-exact
+    got = read_degraded(sinfo, ec, store, hinfo, 0, len(obj))
+    assert got == obj
+
+
+def test_scrub_and_repair_clean_skips_remap():
+    ec, sinfo, _, shards, hinfo = make_object()
+    osdmap = build_cluster()
+    _, _, acting, _ = osdmap.pg_to_up_acting_osds(2, 1)
+    store, _ = inject(shards, [], seed=1, chunk_size=sinfo.chunk_size)
+    rep, remap = scrub_and_repair(sinfo, ec, store, hinfo,
+                                  osdmap=osdmap, pool_id=2, ps=1,
+                                  acting=acting)
+    assert remap is None and rep.scrub.is_clean
+
+
+def test_zero_stripe_across_all_shards_is_unrecoverable():
+    """Whole-stripe zeroing damages every shard: shard-granular crc
+    classification must flag them ALL and refuse repair."""
+    ec, sinfo, _, shards, hinfo = make_object()
+    store, _ = inject(shards, [ZeroStripe(stripe=1)], seed=15,
+                      chunk_size=sinfo.chunk_size)
+    report = deep_scrub(sinfo, ec, store, hinfo)
+    assert report.corrupt == list(range(N))
+    with pytest.raises(UnrecoverableError):
+        repair(sinfo, ec, store, hinfo, report)

@@ -33,22 +33,21 @@ PG_NUM = 24
 EPOCHS = 30
 
 
-def build():
+def build(pg_num=PG_NUM):
     b = CrushBuilder()
     root = b.build_two_level(N_HOSTS, DEVS)
     b.add_rule(0, [step_take(root),
                    step_chooseleaf_indep(K + M, b.type_id("host")),
                    step_emit()])
     m = OSDMap(crush=b.map)
-    m.pools[3] = PGPool(pool_id=3, pg_num=PG_NUM, size=K + M,
+    m.pools[3] = PGPool(pool_id=3, pg_num=pg_num, size=K + M,
                         erasure=True)
     return m
 
 
-@pytest.mark.slow
-def test_thrash_placement_and_decodability():
-    rng = np.random.default_rng(2024)
-    osdmap = build()
+def _thrash(epochs, pg_num, seed=2024):
+    rng = np.random.default_rng(seed)
+    osdmap = build(pg_num)
     reg = ErasureCodePluginRegistry.instance()
     ec = reg.factory("jerasure", {"technique": "reed_sol_van",
                                   "k": str(K), "m": str(M)})
@@ -63,7 +62,7 @@ def test_thrash_placement_and_decodability():
     holder = {i: acting0[i] for i in range(K + M)}
 
     down: set = set()
-    for epoch in range(EPOCHS):
+    for epoch in range(epochs):
         # thrash: flip one osd down (or revive), never exceeding m down
         if down and (len(down) >= M or rng.random() < 0.4):
             osd = int(rng.choice(sorted(down)))
@@ -79,7 +78,7 @@ def test_thrash_placement_and_decodability():
             osdmap.mark_out(osd)
 
         up_all, _ = osdmap.pg_to_up_bulk(3, engine="host")
-        for pg in range(PG_NUM):
+        for pg in range(pg_num):
             members = [int(o) for o in up_all[pg] if o != CRUSH_ITEM_NONE]
             # determinism
             again, *_ = osdmap.pg_to_up_acting_osds(3, pg)
@@ -107,3 +106,15 @@ def test_thrash_placement_and_decodability():
                 new_home = acting_now[s]
                 if new_home != CRUSH_ITEM_NONE:
                     holder[s] = new_home
+
+
+@pytest.mark.slow
+def test_thrash_placement_and_decodability():
+    """The full thrash run (round gate / tools/test_full.sh)."""
+    _thrash(EPOCHS, PG_NUM)
+
+
+def test_thrash_smoke():
+    """Non-slow slice of the SAME thrash loop (few epochs, small
+    pg_num) so tier-1 exercises the thrash invariants on every run."""
+    _thrash(epochs=6, pg_num=8, seed=77)

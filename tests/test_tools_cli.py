@@ -300,6 +300,33 @@ def test_crushtool_edit_surface(tmp_path):
     assert r.returncode != 0 and "Traceback" not in r.stderr
 
 
+def test_scrub_demo_recoverable_and_unrecoverable():
+    """tools/scrub_demo.py: the chaos→scrub→repair→remap CLI — rc 0 +
+    healed report under budget, rc 2 + structured unrecoverable report
+    past it (the same gates tools/test_full.sh enforces)."""
+    import os
+    script = os.path.join(REPO_ROOT, "tools", "scrub_demo.py")
+    r = subprocess.run([sys.executable, script, "--erasures", "1",
+                        "--corruptions", "1", "--transient", "2",
+                        "--json"],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["repair"]["healed"] is True
+    assert out["repair"]["reencode_verified"] is True
+    assert out["scrub"]["retried_shards"]      # transient path hit
+    assert out["remap"]["marked_osds"]
+    assert set(out["remap"]["moved"])          # bad slots re-homed
+
+    r = subprocess.run([sys.executable, script, "--erasures", "3",
+                        "--corruptions", "1", "--json"],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 2, r.stderr
+    out = json.loads(r.stdout)
+    assert len(out["unrecoverable"]["shards"]) == 4
+    assert out["unrecoverable"]["extents"]
+
+
 def test_crushtool_add_item_validation(tmp_path):
     """Duplicate ids/names and device locations are rejected cleanly
     (CrushWrapper::insert_item semantics), and an --add-item is visible

@@ -7,4 +7,12 @@ cd "$(dirname "$0")/.."
 # (The same gate runs inside tier-1 as tests/test_tpu_lint.py; running
 # it here too makes a lint regression fail in seconds, not minutes.)
 python tools/tpu_lint.py ceph_tpu/ tools/ || exit 1
+# Chaos/scrub end-to-end smoke (docs/ROBUSTNESS.md): a recoverable
+# fault mix must heal (rc 0) and a past-budget mix must fail with the
+# structured unrecoverable report (rc 2) — in seconds, before the full
+# suite runs the seeded fuzz (tests/test_scrub_fuzz.py).
+python tools/scrub_demo.py --erasures 1 --corruptions 1 --transient 2 \
+    >/dev/null || exit 1
+python tools/scrub_demo.py --erasures 3 --corruptions 1 >/dev/null 2>&1
+[ $? -eq 2 ] || { echo "scrub_demo: expected unrecoverable rc 2"; exit 1; }
 CEPH_TPU_FULL=1 exec python -m pytest tests/ -q "$@"
