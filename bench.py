@@ -47,6 +47,15 @@ from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
 REPO = os.path.dirname(os.path.abspath(__file__))
 LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 
+# Series marker for cross-round trend consumers (ADVICE round 5).
+# v2: the headline `value` is pinned to the CARRY-chain measurement
+# (continuous with the r02–r04 series); the roofline-honest slice-
+# chain number moved to the separate `slice_gbps` field instead of
+# competing for the headline max — a harness-accounting step-up must
+# never read as a kernel win.  Rows before this marker (r01–r05) are
+# implicitly version 1.
+METRIC_VERSION = 2
+
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
               "--parameter", "k=8", "--parameter", "m=3",
@@ -63,20 +72,25 @@ DECODE_ROWS = [
       "--workload", "decode", "-e", "2",
       "--device", "jax", "--batch", "64", "--loop", "1024",
       "--layout", "packed", "--chain", "slice"]),
-    # shec/clay decode is pure-XLA (no Pallas step), so the slice
-    # chain is INVALID for them — XLA would narrow the decode to the
-    # one sliced element and the number would be fiction; they keep
-    # the conservative carry chain (see build_chain docstring).
+    # shec decode now routes through the unified composite engine: the
+    # plan matrix runs the generalized packed Pallas kernel, which is
+    # opaque to XLA DCE, so the packed slice chain is valid for it.
     ("shec_k6_m3_c2_e1",
      ["--plugin", "shec", "--parameter", "k=6", "--parameter", "m=3",
       "--parameter", "c=2", "--size", str(6 * 131072),
       "--workload", "decode", "-e", "1",
-      "--device", "jax", "--batch", "32", "--loop", "256"]),
+      "--device", "jax", "--batch", "32", "--loop", "256",
+      "--layout", "packed", "--chain", "slice"]),
+    # clay's 64x704 single-erasure composite routes to the MXU einsum
+    # (pure XLA, NOT DCE-opaque — the bench gate rejects slice for
+    # it), so it runs packed + carry: one packed dispatch per step,
+    # conservative chain accounting.
     ("clay_k8_m4_d11_e1",
      ["--plugin", "clay", "--parameter", "k=8", "--parameter", "m=4",
       "--parameter", "d=11", "--size", str(1 << 20),
       "--workload", "decode", "-e", "1",
-      "--device", "jax", "--batch", "16", "--loop", "64"]),
+      "--device", "jax", "--batch", "16", "--loop", "64",
+      "--layout", "packed", "--chain", "carry"]),
 ]
 
 # Degraded / recovery-path rows (ISSUE 2): deep-scrub verify + repair
@@ -95,17 +109,32 @@ DEGRADED_ROWS = [
     ("rs_k8_m3_scrub_e0", ["-e", "0"]),
     ("rs_k8_m3_degraded_e1", ["-e", "1"]),
     ("rs_k8_m3_degraded_e2_c1", ["-e", "2", "--corruptions", "1"]),
+    # batched scrub repair (unified engine): 16 objects of 256 KiB
+    # grouped by erasure pattern, ONE fused decode→re-encode dispatch
+    # per pattern batch — measured every round so the batching win
+    # (and the device-call count staying == pattern count) is
+    # tracked.  argparse last-wins lets the row override the common
+    # workload/device/size.
+    ("rs_k8_m3_repair_batched_e1",
+     ["--workload", "repair-batched", "--device", "jax",
+      "--size", str(1 << 18), "--batch", "16", "-e", "1"]),
 ]
 
 
-def _degraded_rows(iterations: int) -> dict:
-    """name -> GB/s (None on failure) for the recovery-path rows."""
+def _degraded_rows(iterations: int, host_only: bool = False) -> dict:
+    """name -> GB/s (None on failure) for the recovery-path rows.
+
+    ``host_only`` (the tunnel-down error path): re-pin every row to
+    --device host (argparse last-wins), so the repair-batched row's
+    device dispatch can never hang on a wedged tunnel — the grouped
+    host path still measures the batching structure."""
     rows = {}
     for name, extra in DEGRADED_ROWS:
+        argv = DEGRADED_COMMON + ["--iterations", str(iterations)] + extra
+        if host_only:
+            argv += ["--device", "host"]
         try:
-            rows[name] = round(_run(
-                DEGRADED_COMMON + ["--iterations", str(iterations)]
-                + extra)["gbps"], 4)
+            rows[name] = round(_run(argv)["gbps"], 4)
         except Exception as e:  # noqa: BLE001 - recorded, never fatal
             rows[name] = None
             print(f"degraded/{name}: {type(e).__name__}: {e}",
@@ -172,6 +201,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
     artifact is never a bare null (VERDICT r03)."""
     return {
         "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
+        "metric_version": METRIC_VERSION,
         "value": None,
         "unit": "GB/s",
         "vs_baseline": None,
@@ -179,7 +209,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "baseline_gbps": round(cpp_gbps, 3),
         "error": msg,
         "host_gbps": round(host_gbps, 3),
-        "degraded_rows": _degraded_rows(iterations=1),
+        "degraded_rows": _degraded_rows(iterations=1, host_only=True),
         "last_good": _read_last_good(),
     }
 
@@ -302,7 +332,16 @@ def main() -> int:
         except (Exception, SystemExit) as e:  # noqa: BLE001
             errors.append(f"decode/{name}: {type(e).__name__}: {e}")
             decode_rows[name] = None
-    best = max(candidates, key=lambda r: r["gbps"])
+    # Headline hygiene (ADVICE round 5 / metric_version 2): the
+    # headline `value` comes from the CARRY-chain candidates only
+    # (falling back to per-call if every chained run failed), keeping
+    # the series continuous with r02–r04; the slice-chain number is
+    # reported separately as `slice_gbps`.
+    carry = [c for c in candidates if c.get("chain") != "slice"]
+    best = max(carry or candidates, key=lambda r: r["gbps"])
+    slice_gbps = max(
+        (round(c["gbps"], 3) for c in candidates
+         if c.get("chain") == "slice" and c.get("loop")), default=None)
     out = {}
     if errors:
         # some device runs failed (e.g. the chained --loop layouts)
@@ -311,6 +350,7 @@ def main() -> int:
         out["partial_error"] = "; ".join(errors)
     out |= {
         "metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
+        "metric_version": METRIC_VERSION,
         "value": round(best["gbps"], 3),
         "unit": "GB/s",
         "vs_baseline": round(best["gbps"] / cpp_gbps, 3),
@@ -322,6 +362,7 @@ def main() -> int:
             (round(c["gbps"], 3) for c in candidates
              if c.get("chain") == "carry" and c.get("loop")),
             default=None),
+        "slice_gbps": slice_gbps,
         "percall_gbps": round(percall["gbps"], 3) if percall else None,
         "decode_gbps": decode_rows.get("rs_k8_m3_e2"),
         "decode_rows": decode_rows,

@@ -108,7 +108,8 @@ class ErasureCodeBench:
         ap.add_argument("-p", "--plugin", default="jerasure",
                         help="erasure code plugin name")
         ap.add_argument("-w", "--workload", default="encode",
-                        choices=["encode", "decode", "degraded"])
+                        choices=["encode", "decode", "degraded",
+                                 "repair-batched"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -199,25 +200,51 @@ class ErasureCodeBench:
                 "DCE-inflated number — use --chain carry")
 
     def _check_packed(self, ec) -> None:
-        """--layout packed needs the w=8 matrix-code packed methods
-        (techniques.MatrixCodeMixin); fail as a clean CLI error before
-        any expensive warmup.  A plugin that overrides the bytes-layout
-        jax method (shec's plan-based decode) has semantics the
-        inherited mixin packed method would bypass — rejected too."""
+        """--layout packed needs a coherent w=8 packed method pair;
+        fail as a clean CLI error before any expensive warmup.  Two
+        ways to qualify: the plugin defines its OWN packed method
+        (shec's plan decode, clay/lrc's composite paths — the unified
+        decode engine), or it inherits the mixin pair unshadowed (a
+        plugin overriding the bytes-layout jax method while inheriting
+        the mixin packed one would have the packed path bypass its
+        semantics — still rejected)."""
         from ..codes.techniques import MatrixCodeMixin
         attr = ("encode_chunks_packed_jax"
                 if self.args.workload == "encode"
                 else "decode_chunks_packed_jax")
         base_attr = attr.replace("_packed", "")
+        own_packed = (getattr(type(ec), attr, None)
+                      is not getattr(MatrixCodeMixin, attr, None))
+        mixin_pair = (getattr(type(ec), base_attr, None)
+                      is getattr(MatrixCodeMixin, base_attr, None))
         ok = (hasattr(ec, attr)
               and getattr(ec, "w", None) == 8
-              and getattr(type(ec), base_attr, None)
-              is getattr(MatrixCodeMixin, base_attr, None))
+              and (own_packed or mixin_pair))
         if not ok:
             raise SystemExit(
                 f"ceph_erasure_code_benchmark: error: --layout packed "
                 f"is not supported by plugin {self.args.plugin!r} with "
                 f"this profile (w=8 matrix codes only)")
+
+    def _decode_step_engine(self, ec, available, pat, packed):
+        """Best-effort compute tier the packed decode step will route
+        to (None = unknown/small): keeps --chain slice honest now that
+        large composite matrices ride the MXU — a bit-sliced einsum is
+        pure XLA, NOT opaque to DCE, so a slice chain over it would
+        report fiction (the same failure mode the Pallas-only gate
+        catches for non-packed configs)."""
+        if not packed:
+            return None
+        comp = getattr(ec, "_decode_composite", None)
+        if comp is None:
+            return None
+        from ceph_tpu.ops.pallas_gf import select_matrix_engine
+        try:
+            _, ms = comp(tuple(available), tuple(pat))
+        except Exception:  # noqa: BLE001 - advisory probe only
+            return None
+        return select_matrix_engine((1, len(ms[0]), 1, 128), ms, 8,
+                                    packed=True)
 
     def _instance(self):
         registry = ErasureCodePluginRegistry.instance()
@@ -408,6 +435,12 @@ class ErasureCodeBench:
             avail_idx = np.array(available)
             packed = a.layout == "packed"
             self._check_slice_chain(packed)
+            if a.chain == "slice" and self._decode_step_engine(
+                    ec, available, pat, packed) == "mxu":
+                raise SystemExit(
+                    "--chain slice is dishonest for this config: the "
+                    "composite decode matrix routes to the MXU einsum "
+                    "(pure XLA, not opaque to DCE) — use --chain carry")
             if packed:
                 self._check_packed(ec)
                 from ceph_tpu.ops.pallas_gf import pack_chunks
@@ -567,11 +600,100 @@ class ErasureCodeBench:
         res["corruptions"] = a.corruptions
         return res
 
+    # -- repair-batched (the unified engine's batched scrub repair:
+    # one fused decode→re-encode device call per erasure-pattern
+    # batch — scrub/deep_scrub.py::repair_batched) ----------------------
+
+    def repair_batched(self) -> dict:
+        """Batched recovery-path throughput: --batch objects of --size
+        logical bytes each, --erasures/--corruptions faults per
+        object, repaired through repair_batched (deep_scrub host CRC +
+        grouped fused device repair).  GB/s is logical object bytes /
+        elapsed; the result carries the pattern-batch and device-call
+        counts so every round's artifact shows the batching held
+        (pattern_batches == device_calls, not one call per object)."""
+        from ..chaos import BitFlip, ShardErasure, inject
+        from ..codes.stripe import HashInfo, StripeInfo
+        from ..codes.stripe import encode as stripe_encode
+        from ..scrub import repair_batched
+        a = self.args
+        ec = self._instance()
+        n = ec.get_chunk_count()
+        k = ec.get_data_chunk_count()
+        if a.erasures < 0 or a.corruptions < 0:
+            raise ValueError("--erasures/--corruptions must be >= 0")
+        if a.erasures + a.corruptions >= n:
+            raise ValueError(
+                f"{a.erasures} erasures + {a.corruptions} corruptions "
+                f"leave no clean shards of {n}")
+        chunk_size = ec.get_chunk_size(a.size)
+        width = k * chunk_size
+        sinfo = StripeInfo(k, width)
+        rng = np.random.default_rng(a.seed)
+        objects = []
+        for i in range(a.batch):
+            obj = rng.integers(0, 256, size=width,
+                               dtype=np.uint8).tobytes()
+            shards = stripe_encode(sinfo, ec, obj)
+            hinfo = HashInfo(n)
+            hinfo.append(0, shards)
+            objects.append((shards, hinfo))
+        hinfos = [h for _, h in objects]
+
+        # a small pool of fault patterns cycled across objects, so the
+        # sweep exercises the grouping (a few patterns, many objects)
+        prng = np.random.default_rng(a.seed + 1)
+        n_pat = max(1, min(4, a.batch))
+        pool = []
+        for _ in range(n_pat):
+            victims = prng.choice(n, size=a.erasures + a.corruptions,
+                                  replace=False)
+            pool.append(([int(v) for v in victims[:a.erasures]],
+                         [int(v) for v in victims[a.erasures:]]))
+
+        def make_stores():
+            stores = []
+            for i, (shards, _) in enumerate(objects):
+                erased, flipped = pool[i % n_pat]
+                injectors = []
+                if erased:
+                    injectors.append(ShardErasure(shards=erased))
+                if flipped:
+                    injectors.append(BitFlip(shards=flipped, flips=1))
+                store, _ = inject(shards, injectors, seed=a.seed + i,
+                                  chunk_size=sinfo.chunk_size)
+                stores.append(store)
+            return stores
+
+        # --device host pins the grouped HOST path (zero jax work —
+        # _instance() already pinned min_xla_bytes, so the plugin
+        # batch calls stay on numpy too): the tunnel-down bench error
+        # path runs this row without ever touching a wedged device
+        dev = a.device != "host"
+        # warm pattern caches + jit traces outside the timer
+        repair_batched(sinfo, ec, make_stores(), hinfos, device=dev)
+        runs = [make_stores() for _ in range(a.iterations)]
+        begin = time.perf_counter()
+        rep = None
+        for stores in runs:
+            rep = repair_batched(sinfo, ec, stores, hinfos, device=dev)
+        elapsed = time.perf_counter() - begin
+        res = self._result("repair-batched", elapsed,
+                           width * a.batch * a.iterations)
+        res["erasures"] = a.erasures
+        res["corruptions"] = a.corruptions
+        res["pattern_batches"] = rep.pattern_batches
+        res["device_calls"] = rep.device_calls
+        res["host_batches"] = rep.host_batches
+        return res
+
     def _run_workload(self) -> dict:
         if self.args.workload == "encode":
             return self.encode()
         if self.args.workload == "degraded":
             return self.degraded()
+        if self.args.workload == "repair-batched":
+            return self.repair_batched()
         return self.decode()
 
 

@@ -197,6 +197,29 @@ def check(dirpath: str, decode_pairs: bool = True) -> List[str]:
         mapping = ec.get_chunk_mapping()
         if not mapping:  # systematic codes must carry payload verbatim
             errors.append(f"{dirpath}: data chunks do not carry payload")
+    # 4. composite decode rows (shec/clay — the unified decode
+    #    engine): the BATCHED per-pattern composite decode — the path
+    #    the bench decode_rows and scrub repair actually run — must
+    #    reproduce the archived bytes for every single erasure.  A
+    #    drift here ships wrong repair bytes even while the scalar
+    #    decode sweep above stays green.
+    if plugin in ("shec", "clay"):
+        stack = np.stack([np.frombuffer(stored[i], dtype=np.uint8)
+                          for i in range(n)])
+        for e in range(n):
+            avail = tuple(i for i in range(n) if i != e)
+            try:
+                rec = np.asarray(ec.decode_chunks_batch(
+                    np.ascontiguousarray(stack[None, list(avail)]),
+                    avail, (e,)))
+            except Exception as exc:  # noqa: BLE001 - recorded below
+                errors.append(
+                    f"{dirpath}: composite decode ({e},) raised {exc!r}")
+                continue
+            if rec[0, 0].tobytes() != stored[e]:
+                errors.append(
+                    f"{dirpath}: composite decode ({e},) chunk {e} "
+                    f"mismatch")
     return errors
 
 

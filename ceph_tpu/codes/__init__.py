@@ -11,6 +11,10 @@
 - ``stripe``     — ECUtil analog: stripe_info_t geometry, batched
                    whole-object encode/decode, crc32c HashInfo
                    (src/osd/ECUtil.{h,cc}).
+- ``engine``     — unified decode/repair engine: cross-call composite
+                   pattern cache (+ recompile guard) and the fused
+                   decode→re-encode device call batched scrub repair
+                   rides (no reference analogue; docs/PERF.md).
 """
 
 from .interface import ErasureCodeInterface, ErasureCodeProfile

@@ -1,0 +1,205 @@
+"""Unified device-resident decode/repair engine — the cross-call
+composite-matrix pattern cache and the fused decode→re-encode call.
+
+Every plugin's decode is, for a fixed (profile, erasure pattern), ONE
+GF(2^8)-linear map — RS/jerasure's inverted Vandermonde submatrix,
+shec's minimum-read plan matrix, lrc's probed layer-walk composite,
+clay's probed layered composite.  The plugins build those matrices
+lazily, but until this module each *instance* rebuilt (and re-traced)
+them from scratch: a fresh factory() per scrub pass meant clay re-ran
+its impulse probe and jax re-jitted an identical program for every
+repair plan.  Two pieces fix that:
+
+- ``PatternCache`` — a process-wide LRU keyed on
+  (plugin class, profile, kind, available, erased).  The cached value
+  carries the composite matrix AND its hashable static form, so a
+  warm hit reuses both the host matrix and the already-traced jit
+  program (jit caches key on the static tuple).  A recompile-count
+  guard (``builds`` vs ``recompile_budget``) turns unbounded pattern
+  churn — the failure mode tpu-lint's static-args rule exists for —
+  into an observable counter and, when a budget is armed, a loud
+  RuntimeError instead of a silent compile storm.
+
+- ``fused_repair_call`` — one jitted program per (plugin, pattern)
+  that decodes the erased shards AND re-encodes the full parity set
+  from the survivors in a single device dispatch: the batched scrub
+  repair path (scrub/deep_scrub.py::repair_batched) crosses
+  host↔device once per erasure-pattern batch instead of once per
+  stripe.  Byte-identical to the per-stripe path by construction (it
+  composes the same decode_chunks_jax / encode_chunks_jax the
+  per-stripe path uses).
+
+Engine selection for the matrix applies themselves lives in
+ops/pallas_gf.py::select_matrix_engine (the Pallas→XLA→numpy table,
+documented in docs/PERF.md); this module is the layer above it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Tuple
+
+from ..utils.log import dout
+
+DEFAULT_MAX_PATTERNS = 512
+
+
+class PatternCache:
+    """Cross-call LRU of per-(plugin, profile, erasure-pattern)
+    decode artifacts, with a recompile-count guard.
+
+    Values are opaque to the cache (matrix/static tuples, jitted
+    callables); the contract is only that a given key always maps to
+    the same value, so eviction + rebuild is correct at any size."""
+
+    def __init__(self, max_patterns: int = DEFAULT_MAX_PATTERNS,
+                 recompile_budget: Optional[int] = None) -> None:
+        self.max_patterns = max_patterns
+        # builds above this raise (tests arm it to pin "bounded jit
+        # recompile count"); None = log-once observability only
+        self.recompile_budget = recompile_budget
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, object]" = OrderedDict()
+        self.hits = 0
+        self.builds = 0
+        self.evictions = 0
+        self._warned = False
+
+    def get_or_build(self, key: tuple, builder: Callable[[], object]):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return hit
+        # build OUTSIDE the lock: clay's impulse probe can take
+        # seconds and must not serialize unrelated patterns
+        value = builder()
+        with self._lock:
+            race = self._entries.get(key)
+            if race is not None:
+                self.hits += 1
+                return race
+            self.builds += 1
+            if (self.recompile_budget is not None
+                    and self.builds > self.recompile_budget):
+                raise RuntimeError(
+                    f"pattern-cache recompile budget exceeded: "
+                    f"{self.builds} composite builds > "
+                    f"{self.recompile_budget} (unbounded erasure-pattern "
+                    f"churn would jit-compile per call)")
+            self._entries[key] = value
+            while len(self._entries) > self.max_patterns:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                if not self._warned:
+                    self._warned = True
+                    dout("ec", 1,
+                         f"pattern cache exceeded {self.max_patterns} "
+                         f"patterns; evicting LRU (repeat plans will "
+                         f"re-trace)")
+            return value
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"patterns": len(self._entries), "hits": self.hits,
+                    "builds": self.builds, "evictions": self.evictions}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = 0
+            self.builds = 0
+            self.evictions = 0
+            self._warned = False
+
+
+_global: Optional[PatternCache] = None
+_global_lock = threading.Lock()
+
+
+def global_pattern_cache() -> PatternCache:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = PatternCache()
+        return _global
+
+
+def set_global_pattern_cache(cache: Optional[PatternCache]
+                             ) -> Optional[PatternCache]:
+    """Swap the process cache (tests); returns the previous one."""
+    global _global
+    with _global_lock:
+        prev = _global
+        _global = cache
+        return prev
+
+
+def pattern_key(ec, kind: str, available: tuple, erased: tuple,
+                extra: tuple = ()) -> tuple:
+    """Cache key for one plugin instance's (pattern, artifact kind).
+
+    Profile-derived, not instance-derived: two factory() calls with
+    the same profile share every composite matrix and jit trace."""
+    return (type(ec).__name__,
+            tuple(sorted((str(k), str(v))
+                         for k, v in ec.get_profile().items())),
+            kind, tuple(available), tuple(erased)) + tuple(extra)
+
+
+# -- fused decode → re-encode (the batched scrub repair device call) ----
+
+def fused_repair_call(ec, available: Tuple[int, ...],
+                      erased: Tuple[int, ...]):
+    """One jitted fn: survivors (B, n_avail, C) uint8 →
+    (rec (B, n_erased, C), parity (B, m, C)) in a SINGLE device
+    dispatch — decode of every erased shard plus the full parity
+    re-encode the repair gate needs, fused so batched repair is one
+    host↔device round-trip per erasure-pattern batch.
+
+    Shard space follows the plugin's decode surface (identity chunk
+    ids, or lrc's global positions via get_chunk_mapping); data chunks
+    for the re-encode are assembled from survivor and decoded columns
+    by static index, so the whole body jit-fuses.  Cached per
+    (plugin, profile, pattern) in the global PatternCache — repeat
+    repair plans hit the warm trace."""
+    import jax
+    import jax.numpy as jnp
+
+    from .stripe import _chunk_mapping
+
+    available = tuple(available)
+    erased = tuple(erased)
+    key = pattern_key(ec, "fused-repair", available, erased)
+
+    def build():
+        mapping = _chunk_mapping(ec)
+        k = ec.get_data_chunk_count()
+        aidx = {s: t for t, s in enumerate(available)}
+        eidx = {s: t for t, s in enumerate(erased)}
+        src = []
+        for c in range(k):
+            shard = mapping[c]
+            if shard in aidx:
+                src.append(("avail", aidx[shard]))
+            elif shard in eidx:
+                src.append(("rec", eidx[shard]))
+            else:
+                raise IOError(
+                    f"data shard {shard} neither available nor erased "
+                    f"in pattern (avail={available}, erased={erased})")
+
+        @jax.jit
+        def fn(stack):
+            rec = ec.decode_chunks_jax(stack, available, erased)
+            cols = [stack[:, t, :] if where == "avail" else rec[:, t, :]
+                    for where, t in src]
+            data = jnp.stack(cols, axis=1)
+            parity = ec.encode_chunks_jax(data)
+            return rec, parity
+
+        return fn
+
+    return global_pattern_cache().get_or_build(key, build)

@@ -601,14 +601,6 @@ class ErasureCodeClay(ErasureCode):
 
     # -- device-resident paths (bench hot loop) -----------------------------
 
-    def _static(self, key: tuple, M: np.ndarray):
-        from ...ops.xla_ops import matrix_to_static
-        ms = self._linear_cache.get(key)
-        if ms is None:
-            ms = matrix_to_static(M)
-            self._linear_cache[key] = ms
-        return ms
-
     def encode_chunks_jax(self, data):
         """(batch, k, chunk) uint8 device array -> (batch, m, chunk) parity
         on device: ONE sparse composite-matrix application (the probed
@@ -617,8 +609,7 @@ class ErasureCodeClay(ErasureCode):
         composite (m*sub x k*sub >= thousands of entries) to the MXU
         bit-sliced matmul on TPU; the unrolled schedule elsewhere."""
         from ...ops.pallas_gf import apply_matrix_best
-        M = self._probe_encode_matrix()
-        ms = self._static(("encode_static",), M)
+        _, ms = self._encode_composite()
         b, k, chunk = data.shape
         sub = self.sub_chunk_no
         x = data.reshape(b, k * sub, chunk // sub)
@@ -632,59 +623,129 @@ class ErasureCodeClay(ErasureCode):
         3.9 GB/s on chip through the unrolled schedule, the motivating
         case for apply_matrix_mxu)."""
         from ...ops.pallas_gf import apply_matrix_best
-        M = self._probe_decode_matrix(tuple(available), tuple(erased))
-        ms = self._static(("decode_static", available, erased), M)
+        _, ms = self._decode_composite(tuple(available), tuple(erased))
         b, na, chunk = chunks.shape
         sub = self.sub_chunk_no
         x = chunks.reshape(b, na * sub, chunk // sub)
         y = apply_matrix_best(x, ms, W)
         return y.reshape(b, len(erased), chunk)
 
+    # -- packed resident layout (ops/pallas_gf.py pack_chunks form) ------
+
+    def _packed_subsplit(self, rows: int) -> int:
+        """Packed rows per sub-chunk; every sub-chunk must own whole
+        uint32 rows for the packed reshape to be a free view."""
+        sub = self.sub_chunk_no
+        if rows % sub:
+            raise ValueError(
+                f"packed clay layout needs sub-chunk-aligned rows: "
+                f"{rows} uint32 rows % {sub} sub-chunks != 0 (chunk "
+                f"must be a multiple of {sub * 512} bytes)")
+        return rows // sub
+
+    def encode_chunks_packed_jax(self, words):
+        """(batch, k, R, 128) uint32 packed -> (batch, m, R, 128)
+        packed parity: sub-chunk rows split off as composite input
+        rows, then ONE packed dispatch (MXU for the large composites,
+        the generalized Pallas kernel otherwise)."""
+        from ...ops.pallas_gf import apply_matrix_packed_best
+        _, ms = self._encode_composite()
+        b, k, rows, lane = words.shape
+        sub = self.sub_chunk_no
+        sr = self._packed_subsplit(rows)
+        x = words.reshape(b, k * sub, sr, lane)
+        y = apply_matrix_packed_best(x, ms)
+        return y.reshape(b, self.m, rows, lane)
+
+    def decode_chunks_packed_jax(self, words, available: tuple,
+                                 erased: tuple):
+        """Packed-layout composite decode/repair: (batch, n_avail, R,
+        128) uint32 -> (batch, len(erased), R, 128) — the single-
+        erasure 64x704 composite as one packed dispatch."""
+        from ...ops.pallas_gf import apply_matrix_packed_best
+        _, ms = self._decode_composite(tuple(available), tuple(erased))
+        b, na, rows, lane = words.shape
+        sub = self.sub_chunk_no
+        sr = self._packed_subsplit(rows)
+        x = words.reshape(b, na * sub, sr, lane)
+        y = apply_matrix_packed_best(x, ms)
+        return y.reshape(b, len(erased), rows, lane)
+
     # -- probed composite matrices (TPU batch path) -------------------------
+    #
+    # Cached (M, static) pairs, cross-instance through the engine
+    # pattern cache: the impulse probe runs the layered decode over a
+    # (k*sub)-wide identity payload — seconds of host work for the
+    # k=8,m=4,d=11 geometry — and the static tuple keys the jit trace,
+    # so a fresh factory() with the same profile reuses both.
+
+    def _encode_composite(self):
+        hit = self._linear_cache.get(("encode",))
+        if hit is None:
+            from ...ops.xla_ops import matrix_to_static
+            from ..engine import global_pattern_cache, pattern_key
+
+            def build():
+                k, sub = self.k, self.sub_chunk_no
+                width = k * sub
+                C = np.zeros((self.n_nodes, sub, width), dtype=np.uint8)
+                c_known = np.zeros((self.n_nodes, sub), dtype=bool)
+                for i in range(k):
+                    for s in range(sub):
+                        C[i, s, i * sub + s] = 1
+                    c_known[i, :] = True
+                c_known[k:k + self.nu, :] = True
+                coding = set(range(self.k + self.nu, self.n_nodes))
+                self._decode_layered(C, c_known, coding)
+                M = np.concatenate(
+                    [C[self.k + self.nu + j] for j in range(self.m)],
+                    axis=0).astype(np.int64)
+                return (M, matrix_to_static(M))
+
+            hit = global_pattern_cache().get_or_build(
+                pattern_key(self, "clay-composite-encode", (), ()),
+                build)
+            self._linear_cache[("encode",)] = hit
+        return hit
 
     def _probe_encode_matrix(self) -> np.ndarray:
         """(m*sub, k*sub) composite encode matrix via impulse probing."""
-        M = self._linear_cache.get(("encode",))
-        if M is not None:
-            return M
-        k, sub = self.k, self.sub_chunk_no
-        width = k * sub
-        C = np.zeros((self.n_nodes, sub, width), dtype=np.uint8)
-        c_known = np.zeros((self.n_nodes, sub), dtype=bool)
-        for i in range(k):
-            for s in range(sub):
-                C[i, s, i * sub + s] = 1
-            c_known[i, :] = True
-        c_known[k:k + self.nu, :] = True
-        coding = set(range(self.k + self.nu, self.n_nodes))
-        self._decode_layered(C, c_known, coding)
-        M = np.concatenate(
-            [C[self.k + self.nu + j] for j in range(self.m)],
-            axis=0).astype(np.int64)
-        self._linear_cache[("encode",)] = M
-        return M
+        return self._encode_composite()[0]
+
+    def _decode_composite(self, available: Tuple[int, ...],
+                          erased: Tuple[int, ...]):
+        key = ("decode", available, erased)
+        hit = self._linear_cache.get(key)
+        if hit is None:
+            from ...ops.xla_ops import matrix_to_static
+            from ..engine import global_pattern_cache, pattern_key
+
+            def build():
+                sub = self.sub_chunk_no
+                width = len(available) * sub
+                chunks = {}
+                for t, c in enumerate(available):
+                    arr = np.zeros((sub, width), dtype=np.uint8)
+                    for s in range(sub):
+                        arr[s, t * sub + s] = 1
+                    chunks[c] = arr.tobytes()
+                out = self._decode_full(set(erased), chunks, sub * width)
+                M = np.concatenate(
+                    [np.frombuffer(out[c], dtype=np.uint8).reshape(
+                        sub, width)
+                     for c in erased], axis=0).astype(np.int64)
+                return (M, matrix_to_static(M))
+
+            hit = global_pattern_cache().get_or_build(
+                pattern_key(self, "clay-composite-decode", available,
+                            erased), build)
+            self._linear_cache[key] = hit
+        return hit
 
     def _probe_decode_matrix(self, available: Tuple[int, ...],
                              erased: Tuple[int, ...]) -> np.ndarray:
         """(len(erased)*sub, len(available)*sub) composite decode matrix."""
-        key = ("decode", available, erased)
-        M = self._linear_cache.get(key)
-        if M is not None:
-            return M
-        sub = self.sub_chunk_no
-        width = len(available) * sub
-        chunks = {}
-        for t, c in enumerate(available):
-            arr = np.zeros((sub, width), dtype=np.uint8)
-            for s in range(sub):
-                arr[s, t * sub + s] = 1
-            chunks[c] = arr.tobytes()
-        out = self._decode_full(set(erased), chunks, sub * width)
-        M = np.concatenate(
-            [np.frombuffer(out[c], dtype=np.uint8).reshape(sub, width)
-             for c in erased], axis=0).astype(np.int64)
-        self._linear_cache[key] = M
-        return M
+        return self._decode_composite(available, erased)[0]
 
 
 class ErasureCodePluginClay(ErasureCodePlugin):

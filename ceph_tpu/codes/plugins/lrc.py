@@ -472,22 +472,38 @@ class ErasureCodeLrc(ErasureCode):
         M, _ = self._probe_encode_matrix()
         return regionops.matrix_encode(np.ascontiguousarray(data), M, W)
 
-    def _probe_decode_matrix(self, available: tuple, erased: tuple):
+    def _decode_composite(self, available: tuple, erased: tuple):
+        """(M, static) for the probed per-pattern composite decode
+        matrix — the layer walk collapsed to ONE (len(erased),
+        len(available)) GF(2^8) map, cached cross-instance through the
+        engine pattern cache so repeat repair plans skip both the
+        probe and the jit re-trace."""
         key = ("decode", available, erased)
         hit = self._linear_cache.get(key)
         if hit is None:
-            na = len(available)
-            chunks = {}
-            for t, c in enumerate(available):
-                arr = np.zeros(na, dtype=np.uint8)
-                arr[t] = 1
-                chunks[c] = arr.tobytes()
-            out = self.decode(set(erased), chunks, na)
-            M = np.stack([np.frombuffer(out[c], dtype=np.uint8)
-                          for c in erased]).astype(np.int64)
-            hit = M
+            from ...ops.xla_ops import matrix_to_static
+            from ..engine import global_pattern_cache, pattern_key
+
+            def build():
+                na = len(available)
+                chunks = {}
+                for t, c in enumerate(available):
+                    arr = np.zeros(na, dtype=np.uint8)
+                    arr[t] = 1
+                    chunks[c] = arr.tobytes()
+                out = self.decode(set(erased), chunks, na)
+                M = np.stack([np.frombuffer(out[c], dtype=np.uint8)
+                              for c in erased]).astype(np.int64)
+                return (M, matrix_to_static(M))
+
+            hit = global_pattern_cache().get_or_build(
+                pattern_key(self, "lrc-composite-decode", available,
+                            erased), build)
             self._linear_cache[key] = hit
         return hit
+
+    def _probe_decode_matrix(self, available: tuple, erased: tuple):
+        return self._decode_composite(available, erased)[0]
 
     def decode_chunks_batch(self, chunks: np.ndarray, available: tuple,
                             erased: tuple) -> np.ndarray:
@@ -496,24 +512,46 @@ class ErasureCodeLrc(ErasureCode):
 
     # -- device-resident paths ----------------------------------------------
 
-    def encode_chunks_jax(self, data):
-        from ...ops.xla_ops import apply_matrix_xla, matrix_to_static
-        M, _ = self._probe_encode_matrix()
+    def _encode_static(self):
         ms = self._linear_cache.get(("encode_static",))
         if ms is None:
+            from ...ops.xla_ops import matrix_to_static
+            M, _ = self._probe_encode_matrix()
             ms = matrix_to_static(M)
             self._linear_cache[("encode_static",)] = ms
-        return apply_matrix_xla(data, ms, W)
+        return ms
+
+    def encode_chunks_jax(self, data):
+        """(batch, k, C) uint8 device array -> (batch, n-k, C) parity:
+        the probed composite through the engine dispatch (Pallas on
+        TPU, XLA elsewhere — apply_matrix_best, not raw XLA, since the
+        composite is an ordinary dense-ish GF(2^8) matrix)."""
+        from ...ops.pallas_gf import apply_matrix_best
+        return apply_matrix_best(data, self._encode_static(), W)
 
     def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
-        from ...ops.xla_ops import apply_matrix_xla, matrix_to_static
-        M = self._probe_decode_matrix(tuple(available), tuple(erased))
-        key = ("decode_static", available, erased)
-        ms = self._linear_cache.get(key)
-        if ms is None:
-            ms = matrix_to_static(M)
-            self._linear_cache[key] = ms
-        return apply_matrix_xla(chunks, ms, W)
+        """(batch, n_avail, C) device array -> (batch, n_erased, C)
+        via the per-pattern composite, engine-dispatched like
+        encode_chunks_jax."""
+        from ...ops.pallas_gf import apply_matrix_best
+        _, ms = self._decode_composite(tuple(available), tuple(erased))
+        return apply_matrix_best(chunks, ms, W)
+
+    # -- packed resident layout (ops/pallas_gf.py pack_chunks form) ------
+
+    def encode_chunks_packed_jax(self, words):
+        """(batch, k, R, 128) uint32 packed -> (batch, n-k, R, 128)
+        packed parity through the composite packed dispatch."""
+        from ...ops.pallas_gf import apply_matrix_packed_best
+        return apply_matrix_packed_best(words, self._encode_static())
+
+    def decode_chunks_packed_jax(self, words, available: tuple,
+                                 erased: tuple):
+        """Packed-layout composite decode: (batch, n_avail, R, 128)
+        uint32 -> (batch, len(erased), R, 128)."""
+        from ...ops.pallas_gf import apply_matrix_packed_best
+        _, ms = self._decode_composite(tuple(available), tuple(erased))
+        return apply_matrix_packed_best(words, ms)
 
 
 class ErasureCodePluginLrc(ErasureCodePlugin):

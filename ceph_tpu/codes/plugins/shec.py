@@ -65,19 +65,32 @@ class ErasureCodeShecTableCache:
     """ErasureCodeShecTableCache.{h,cc} — decode-plan cache per pattern.
 
     The reference caches jerasure decoding tables keyed by erasure
-    pattern; here the expensive artifacts are the composed decode matrix
-    (host) and its jit trace (device), keyed the same way.
+    pattern; here the expensive artifacts are the minimum-read plan
+    search, the composed decode matrix (host) and its jit trace
+    (device), keyed the same way.  Two-level like the mixin caches: a
+    per-instance dict in front of the process-wide engine.PatternCache
+    (``owner`` supplies the profile key), so fresh instances with the
+    same profile skip the cover-problem search entirely.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, owner=None) -> None:
         self._plans: dict = {}
+        self._owner = owner
 
     def get_plan(self, matrix: np.ndarray, k: int, w: int,
                  available: frozenset, want: frozenset) -> DecodePlan:
         key = (available, want)
         plan = self._plans.get(key)
         if plan is None:
-            plan = decode_plan(matrix, k, w, available, want)
+            if self._owner is not None:
+                from ..engine import global_pattern_cache, pattern_key
+                plan = global_pattern_cache().get_or_build(
+                    pattern_key(self._owner, "shec-plan",
+                                tuple(sorted(available)),
+                                tuple(sorted(want))),
+                    lambda: decode_plan(matrix, k, w, available, want))
+            else:
+                plan = decode_plan(matrix, k, w, available, want)
             self._plans[key] = plan
         return plan
 
@@ -117,7 +130,7 @@ class ErasureCodeShec(MatrixCodeMixin, ErasureCode):
 
     def prepare(self) -> None:
         super().prepare()  # MatrixCodeMixin: matrix + static + caches
-        self.tcache = ErasureCodeShecTableCache()
+        self.tcache = ErasureCodeShecTableCache(self)
         self._windows = [frozenset(int(j) for j in np.nonzero(self.matrix[i])[0])
                          for i in range(self.m)]
 
@@ -179,15 +192,26 @@ class ErasureCodeShec(MatrixCodeMixin, ErasureCode):
         keep = np.array([worder[c] for c in erased])
         return np.ascontiguousarray(out[:, keep, :])
 
-    def _apply_plan(self, plan: DecodePlan, stack: np.ndarray) -> np.ndarray:
-        from ...ops.xla_ops import matrix_to_static
+    def _plan_static(self, plan: DecodePlan):
+        """(matrix, static, n_reads) for a plan — the per-pattern
+        composite artifact, shared cross-instance through the engine
+        pattern cache so repeat plans hit warm jit traces."""
         key = (plan.reads, plan.want_order)
         cache = self._decode_cache
         hit = cache.get(key)
         if hit is None:
-            hit = (plan.matrix, matrix_to_static(plan.matrix), len(plan.reads))
+            from ...ops.xla_ops import matrix_to_static
+            from ..engine import global_pattern_cache, pattern_key
+            hit = global_pattern_cache().get_or_build(
+                pattern_key(self, "shec-plan-static", plan.reads,
+                            plan.want_order),
+                lambda: (plan.matrix, matrix_to_static(plan.matrix),
+                         len(plan.reads)))
             cache[key] = hit
-        dm, dm_static, _ = hit
+        return hit
+
+    def _apply_plan(self, plan: DecodePlan, stack: np.ndarray) -> np.ndarray:
+        dm, dm_static, _ = self._plan_static(plan)
         return self._apply(stack, dm, dm_static)
 
     def decode_chunks_jax(self, chunks, available: tuple, erased: tuple):
@@ -200,20 +224,39 @@ class ErasureCodeShec(MatrixCodeMixin, ErasureCode):
         lesson the encode path learned in round 3; this was the shec
         decode row's 17 GB/s bottleneck)."""
         from ...ops.pallas_gf import apply_matrix_best
-        from ...ops.xla_ops import (jax_bytes_view, jax_words_view,
-                                    matrix_to_static)
+        from ...ops.xla_ops import jax_bytes_view, jax_words_view
         plan = self.tcache.get_plan(self.matrix, self.k, self.w,
                                     frozenset(available), frozenset(erased))
         aidx = {c: t for t, c in enumerate(available)}
         sel = [aidx[c] for c in plan.reads]
         worder = {c: t for t, c in enumerate(plan.want_order)}
+        _, dm_static, _ = self._plan_static(plan)
         sub = chunks[:, np.array(sel), :]
         words = jax_words_view(sub, self.w)
-        out = apply_matrix_best(words, matrix_to_static(plan.matrix),
-                                self.w)
+        out = apply_matrix_best(words, dm_static, self.w)
         out = jax_bytes_view(out)
         keep = np.array([worder[c] for c in erased])
         return out[:, keep, :]
+
+    def decode_chunks_packed_jax(self, words, available: tuple,
+                                 erased: tuple):
+        """Packed-layout minimum-read decode: (batch, n_avail, R, 128)
+        uint32 -> (batch, len(erased), R, 128) — the plan's composite
+        matrix through the packed dispatch (the generalized Pallas
+        kernel on TPU; plan shapes like (1, 7) ride the padded row
+        tiles).  w=8 profiles only, like every packed path."""
+        if self.w != 8:
+            raise ValueError("packed layout is w=8 only")
+        from ...ops.pallas_gf import apply_matrix_packed_best
+        plan = self.tcache.get_plan(self.matrix, self.k, self.w,
+                                    frozenset(available), frozenset(erased))
+        aidx = {c: t for t, c in enumerate(available)}
+        sel = np.array([aidx[c] for c in plan.reads])
+        worder = {c: t for t, c in enumerate(plan.want_order)}
+        _, dm_static, _ = self._plan_static(plan)
+        out = apply_matrix_packed_best(words[:, sel], dm_static)
+        keep = np.array([worder[c] for c in erased])
+        return out[:, keep]
 
 
 class ErasureCodeShecReedSolomonVandermonde(ErasureCodeShec):

@@ -14,8 +14,12 @@ Path selection: below ``min_xla_bytes`` the numpy reference region ops run
 (no trace/compile cost); above it, the jit XLA path. Both are byte-
 identical and cross-pinned in tests.
 
-Decode-matrix caches are per-instance (reset by prepare()), mirroring
-ErasureCodeIsaTableCache's role without pinning instances in a global.
+Decode-matrix caches are two-level: a per-instance dict (reset by
+prepare(), mirroring ErasureCodeIsaTableCache) in front of the
+process-wide engine.PatternCache, so a FRESH plugin instance with the
+same profile reuses both the composed matrix and the already-traced
+jit program for every erasure pattern seen before (the unified decode
+engine's warm path; docs/PERF.md).
 """
 
 from __future__ import annotations
@@ -89,10 +93,17 @@ class MatrixCodeMixin:
         key = (available, erased)
         hit = self._decode_cache.get(key)
         if hit is None:
-            survivors = list(available[:self.k])
-            dm = regionops.matrix_decode_matrix(
-                self.matrix, self.k, survivors, list(erased), self.w)
-            hit = (dm, matrix_to_static(dm), len(survivors))
+            from .engine import global_pattern_cache, pattern_key
+
+            def build():
+                survivors = list(available[:self.k])
+                dm = regionops.matrix_decode_matrix(
+                    self.matrix, self.k, survivors, list(erased), self.w)
+                return (dm, matrix_to_static(dm), len(survivors))
+
+            hit = global_pattern_cache().get_or_build(
+                pattern_key(self, "matrix-decode", available, erased),
+                build)
             self._decode_cache[key] = hit
         return hit
 
@@ -191,10 +202,18 @@ class BitmatrixCodeMixin:
         key = (available, erased)
         hit = self._decode_cache.get(key)
         if hit is None:
-            survivors = list(available[:self.k])
-            dm = regionops.bitmatrix_decode_matrix(
-                self.bitmatrix, self.k, self.w, survivors, list(erased))
-            hit = (dm, bitmatrix_to_static(dm), len(survivors))
+            from .engine import global_pattern_cache, pattern_key
+
+            def build():
+                survivors = list(available[:self.k])
+                dm = regionops.bitmatrix_decode_matrix(
+                    self.bitmatrix, self.k, self.w, survivors,
+                    list(erased))
+                return (dm, bitmatrix_to_static(dm), len(survivors))
+
+            hit = global_pattern_cache().get_or_build(
+                pattern_key(self, "bitmatrix-decode", available, erased),
+                build)
             self._decode_cache[key] = hit
         return hit
 

@@ -940,12 +940,17 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
     rule = cm.cmap.rules[ruleno]
     tunables = cm.cmap.tunables
     if (tunables.choose_local_tries or tunables.choose_local_fallback_tries
-            or not tunables.chooseleaf_vary_r
-            or not tunables.chooseleaf_stable
+            or tunables.chooseleaf_vary_r != 1
+            or tunables.chooseleaf_stable != 1
             or not tunables.chooseleaf_descend_once):
         # the fused program hardcodes jewel chooseleaf semantics
         # (sub_r = r, recursion rep 0, one leaf try); older profiles run
-        # on the host mapper
+        # on the host mapper.  The vary_r/stable checks are EXACT-value,
+        # not truthiness: vary_r >= 2 is a legal upstream transitional
+        # value whose host semantics are sub_r = r >> (vary_r - 1) —
+        # a map carrying it would pass a falsy-only guard and silently
+        # diverge from the host mapper with no need_host flag (ADVICE
+        # round 5); the same reasoning gates chooseleaf_stable > 1.
         raise ValueError("bulk evaluator requires jewel tunables "
                          "(choose_local_* == 0, chooseleaf_vary_r/"
                          "stable/descend_once == 1); use engine=host")

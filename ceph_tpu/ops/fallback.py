@@ -17,8 +17,11 @@ observable decision:
 - ``CEPH_TPU_ENGINE=pallas|xla|numpy`` force-overrides for tests and
   benches, replacing ad-hoc monkeypatching of the probe.
 
-``pallas_gf.use_pallas`` and the mixin host/device split in
-``codes/techniques.py`` route through ``global_policy()``.
+``pallas_gf.use_pallas``, the per-matrix engine selection table
+(``pallas_gf.select_matrix_engine`` — MXU/Pallas/XLA/numpy per
+(shape, matrix, layout); docs/PERF.md "Unified decode/repair engine"
+has the table) and the mixin host/device split in
+``codes/techniques.py`` all route through ``global_policy()``.
 """
 
 from __future__ import annotations

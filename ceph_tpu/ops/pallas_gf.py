@@ -53,6 +53,7 @@ from .xla_ops import xtime_swar as _xtime_swar
 
 LANE = 128            # TPU lane width
 SUBLANE_U8 = 32       # uint8 VMEM tile is (32, 128)
+SUBLANE_U32 = 8       # uint32 VMEM tile is (8, 128)
 MAX_ROW_TILE8 = 512   # u8 rows of 128 lanes per block: 64 KiB per chunk
 
 
@@ -145,9 +146,10 @@ def _row_tile8(rows: int) -> int:
 
 def pallas_matrix_supported(shape, w: int) -> bool:
     """True when (..., s, C) uint8 chunks fit the byte kernel's
-    tiling: w=8 and C a multiple of 32*128 bytes (every SIMD-aligned
-    chunk size >= 4 KiB qualifies; others fall back to the XLA path or
-    the word kernel)."""
+    tiling WITHOUT padding: w=8 and C a multiple of 32*128 bytes
+    (every SIMD-aligned chunk size >= 4 KiB qualifies; others pad
+    through pallas_matrix_padded_supported or fall back to the XLA
+    path / the word kernel)."""
     if w != 8 or len(shape) < 2:
         return False
     c = shape[-1]
@@ -156,34 +158,55 @@ def pallas_matrix_supported(shape, w: int) -> bool:
     return _row_tile8(c // LANE) != 0
 
 
+def pallas_matrix_padded_supported(shape, w: int) -> bool:
+    """The composite-matrix generalization of pallas_matrix_supported:
+    any lane-aligned chunk size qualifies — row counts off the native
+    u8 sublane tile are zero-padded up to it inside the kernel wrapper
+    and the pad rows are masked off on writeback.  GF(2^8) region math
+    is byte-local, so pad bytes never mix into real rows.  Shapes like
+    clay's (..., 704, 2048) single-erasure composite (16 u8 rows, not
+    a 32-row tile) land here."""
+    if w != 8 or len(shape) < 2:
+        return False
+    c = shape[-1]
+    return c > 0 and c % LANE == 0
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def apply_matrix_pallas(chunks: jax.Array, matrix_t,
                         interpret: bool = False) -> jax.Array:
     """Apply a static (r, s) GF(2^8) matrix to (..., s, C) uint8
     chunks -> (..., r, C) parity/decode output.  Same contract as
     xla_ops.apply_matrix_xla (w=8); caller gates on
-    pallas_matrix_supported."""
+    pallas_matrix_padded_supported (row counts off the native sublane
+    tile are zero-padded and the pad rows masked off on writeback)."""
     r = len(matrix_t)
     s = len(matrix_t[0])
     assert chunks.shape[-2] == s and chunks.dtype == jnp.uint8
     lead = chunks.shape[:-2]
     c = chunks.shape[-1]
     rows = c // LANE
-    rt = _row_tile8(rows)
     b = int(np.prod(lead)) if lead else 1
     tiles = chunks.reshape(b, s, rows, LANE)
+    pad = (-rows) % SUBLANE_U8
+    if pad:
+        tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    prows = rows + pad
+    rt = _row_tile8(prows)
     out = pl.pallas_call(
         _gf8_matrix_kernel(matrix_t, s, r, interpret),
-        grid=(b, rows // rt),
+        grid=(b, prows // rt),
         in_specs=[pl.BlockSpec((1, s, rt, LANE),
                                lambda i, j: (i, 0, j, 0),
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((1, r, rt, LANE),
                                lambda i, j: (i, 0, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, r, rows, LANE), jnp.uint8),
+        out_shape=jax.ShapeDtypeStruct((b, r, prows, LANE), jnp.uint8),
         interpret=interpret,
     )(tiles)
+    if pad:
+        out = out[..., :rows, :]
     return out.reshape(lead + (r, c))
 
 
@@ -292,53 +315,91 @@ def unpack_chunks(words: np.ndarray) -> np.ndarray:
         words.shape[:-2] + (r * 4 * LANE,))
 
 
+def pallas_matrix_packed_supported(shape) -> bool:
+    """Packed-layout gate, post-generalization: ANY (..., s, R, 128)
+    uint32 array qualifies — row counts off the native u32 sublane
+    tile are zero-padded inside apply_matrix_pallas_packed and the pad
+    rows masked off on writeback (the composite-matrix shapes: clay's
+    per-sub-chunk 4-row tiles, shec/lrc minimum-read stacks)."""
+    return len(shape) >= 3 and shape[-1] == LANE and shape[-2] >= 1
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def apply_matrix_pallas_packed(words: jax.Array, matrix_t,
                                interpret: bool = False) -> jax.Array:
     """Packed-layout apply: (..., s, R, 128) uint32 -> (..., r, R, 128).
-    Same math as apply_matrix_pallas (w=8), zero layout work."""
+    Same math as apply_matrix_pallas (w=8), zero layout work.
+
+    Accepts ARBITRARY (r, s) composite matrices and row counts: a row
+    count off the native u32 sublane tile is zero-padded up to it and
+    the pad rows are masked off on writeback — GF(2^8) region math is
+    byte-local, so pad words never mix into real output rows."""
     r = len(matrix_t)
     s = len(matrix_t[0])
     assert words.shape[-3] == s and words.dtype == jnp.uint32
     assert words.shape[-1] == LANE
     lead = words.shape[:-3]
     rows = words.shape[-2]
-    rt = _row_tile8(rows * 4) // 4
-    if rt == 0 or rows % rt:
-        rt = rows  # small shapes: one block per chunk
     b = int(np.prod(lead)) if lead else 1
     tiles = words.reshape(b, s, rows, LANE)
+    pad = (-rows) % SUBLANE_U32
+    if pad:
+        tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    prows = rows + pad
+    rt = _row_tile8(prows * 4) // 4
+    if rt == 0 or prows % rt:
+        rt = prows  # small shapes: one block per chunk
     out = pl.pallas_call(
         _gf8_matrix_kernel(matrix_t, s, r, interpret, packed=True),
-        grid=(b, rows // rt),
+        grid=(b, prows // rt),
         in_specs=[pl.BlockSpec((1, s, rt, LANE),
                                lambda i, j: (i, 0, j, 0),
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((1, r, rt, LANE),
                                lambda i, j: (i, 0, j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, r, rows, LANE), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((b, r, prows, LANE), jnp.uint32),
         interpret=interpret,
     )(tiles)
+    if pad:
+        out = out[..., :rows, :]
     return out.reshape(lead + (r, rows, LANE))
 
 
-def apply_matrix_packed_best(words: jax.Array, matrix_t) -> jax.Array:
-    """Packed-layout dispatch: the Pallas packed kernel on TPU; on
-    other backends, bitcast to bytes and take the XLA path (CPU has no
-    tiled layouts, so the casts are cheap there).  Byte-identical
-    either way."""
-    if use_pallas():
-        return apply_matrix_pallas_packed(words, matrix_t)
-    from .xla_ops import apply_matrix_xla
+def _packed_to_bytes(words: jax.Array):
+    """(..., s, R, 128) uint32 -> (..., s, R*512) uint8 device bitcast
+    (the byte view the XLA/MXU paths consume; same idiom the packed
+    XLA fallback has always used, pinned byte-identical in tests)."""
     lead = words.shape[:-3]
     s, rows = words.shape[-3], words.shape[-2]
-    chunks = jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(
         lead + (s, rows * 4 * LANE))
-    out = apply_matrix_xla(chunks, matrix_t, 8)
-    r = len(matrix_t)
+
+
+def _bytes_to_packed(chunks: jax.Array):
+    """Inverse of _packed_to_bytes."""
+    lead = chunks.shape[:-2]
+    r, c = chunks.shape[-2], chunks.shape[-1]
     return jax.lax.bitcast_convert_type(
-        out.reshape(lead + (r, rows, LANE, 4)), jnp.uint32)
+        chunks.reshape(lead + (r, c // (4 * LANE), LANE, 4)), jnp.uint32)
+
+
+def apply_matrix_packed_best(words: jax.Array, matrix_t) -> jax.Array:
+    """Packed-layout dispatch through the selection table
+    (select_matrix_engine / docs/PERF.md): MXU for large composite
+    matrices, the generalized Pallas packed kernel otherwise on TPU;
+    on other backends, bitcast to bytes and take the XLA path (CPU has
+    no tiled layouts, so the casts are cheap there).  Byte-identical
+    in every branch."""
+    from . import xla_ops
+    eng = select_matrix_engine(words.shape, matrix_t, 8, packed=True)
+    if eng == "mxu":
+        out = xla_ops.apply_matrix_mxu(_packed_to_bytes(words), matrix_t)
+        return _bytes_to_packed(out)
+    if eng == "pallas":
+        return apply_matrix_pallas_packed(words, matrix_t)
+    out = xla_ops.apply_matrix_xla(_packed_to_bytes(words), matrix_t, 8)
+    return _bytes_to_packed(out)
 
 
 def _bitmatrix_kernel(rows_masks, s: int, w: int, r: int, rt: int):
@@ -452,29 +513,73 @@ def _matrix_nnz(matrix_t) -> int:
     return sum(1 for row in matrix_t for v in row if v)
 
 
+def select_matrix_engine(shape, matrix_t, w: int = 8,
+                         packed: bool = False,
+                         engine: str | None = None) -> str:
+    """THE engine-selection table for GF(2^w) matrix applies — one
+    place that decides, for a (shape, matrix, layout) triple, which
+    compute tier runs it (docs/PERF.md has the human-readable table;
+    ops/fallback.py supplies the device tier).  Returns one of:
+
+    - "mxu":    w=8 composite matrix with >= MXU_MATRIX_MIN nonzeros
+                on a Pallas-capable backend — the bit-sliced GF(2)
+                matmul (clay's 64x704 single-erasure composite).
+    - "pallas": the bit-sliced VPU kernel (byte, padded-byte, packed,
+                or word variant per layout/w) on a TPU backend.
+    - "xla":    the SWAR XLA path (non-TPU backends, or shapes no
+                Pallas variant supports).
+    - "numpy":  the fallback policy dropped to the host ground truth;
+                callers must not dispatch through jax at all.
+
+    ``engine`` overrides the probed fallback-policy tier (tests).
+    Pure function of its arguments — the routing tests assert on it
+    directly."""
+    if engine is None:
+        from .fallback import global_policy
+        engine = global_policy().engine(_device_kind())
+    if engine == "numpy":
+        return "numpy"
+    if engine != "pallas":
+        return "xla"
+    nnz = _matrix_nnz(matrix_t) if matrix_t else 0
+    if w == 8 and nnz >= MXU_MATRIX_MIN:
+        return "mxu"
+    if packed:
+        return "pallas" if pallas_matrix_packed_supported(shape) else "xla"
+    if w == 8:
+        return ("pallas" if pallas_matrix_padded_supported(shape, w)
+                else "xla")
+    if w in (16, 32):
+        return ("pallas" if pallas_matrix_words_supported(shape, w)
+                else "xla")
+    return "xla"
+
+
 def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
-    """Dispatch over the engines, byte-identical in every branch
-    (cross-pinned in tests):
+    """Dispatch over the engines via select_matrix_engine,
+    byte-identical in every branch (cross-pinned in tests):
 
     - w=8, LARGE matrix (>= MXU_MATRIX_MIN entries) on TPU: the
       bit-sliced GF(2) matmul on the MXU (clay composites).
-    - w=8, uint8 in: the byte Pallas kernel on TPU, XLA otherwise.
+    - w=8, uint8 in: the byte Pallas kernel on TPU (row counts off the
+      sublane tile pad + mask — the composite generalization), XLA
+      otherwise.
     - w=16/32, word-typed in (uint16/uint32 views — what the plugin
       mixins pass): the word Pallas kernel on TPU, XLA otherwise.
     """
     from . import xla_ops
     from .xla_ops import apply_matrix_xla
-    if (w == 8 and chunks.dtype == jnp.uint8 and use_pallas()
-            and _matrix_nnz(matrix_t) >= MXU_MATRIX_MIN):
+    word_typed = ((w == 8 and chunks.dtype == jnp.uint8)
+                  or (w in (16, 32) and chunks.dtype == _WORD_DTYPE.get(w)))
+    eng = (select_matrix_engine(chunks.shape, matrix_t, w)
+           if word_typed else "xla")
+    if eng == "mxu":
         # module attribute (not a local import) so the routing test
         # can observe which engine was selected
         return xla_ops.apply_matrix_mxu(chunks, matrix_t)
-    if (w == 8 and chunks.dtype == jnp.uint8 and use_pallas()
-            and pallas_matrix_supported(chunks.shape, w)):
-        return apply_matrix_pallas(chunks, matrix_t)
-    if (w in (16, 32) and chunks.dtype == _WORD_DTYPE.get(w)
-            and use_pallas()
-            and pallas_matrix_words_supported(chunks.shape, w)):
+    if eng == "pallas":
+        if w == 8:
+            return apply_matrix_pallas(chunks, matrix_t)
         return apply_matrix_pallas_words(chunks, matrix_t, w)
     return apply_matrix_xla(chunks, matrix_t, w)
 

@@ -11,6 +11,7 @@ returning garbage.  See docs/ROBUSTNESS.md.
 
 from .deep_scrub import (  # noqa: F401
     CRC_SEED,
+    BatchRepairReport,
     RemapReport,
     RepairReport,
     ScrubReport,
@@ -20,6 +21,7 @@ from .deep_scrub import (  # noqa: F401
     deep_scrub,
     read_degraded,
     repair,
+    repair_batched,
     scrub_and_repair,
     unrecoverable_extents,
 )

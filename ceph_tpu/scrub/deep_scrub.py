@@ -114,6 +114,22 @@ class RepairReport:
 
 
 @dataclass
+class BatchRepairReport:
+    """repair_batched's outcome across many objects: per-object
+    RepairReports plus the device-traffic accounting the batching
+    exists for (one fused dispatch per erasure-pattern batch)."""
+
+    reports: List[RepairReport] = field(default_factory=list)
+    pattern_batches: int = 0     # distinct (reads, erased, len) groups
+    device_calls: int = 0        # fused decode+re-encode dispatches
+    host_batches: int = 0        # groups served by the numpy tier
+
+    @property
+    def repaired_objects(self) -> List[int]:
+        return [i for i, r in enumerate(self.reports) if r.repaired]
+
+
+@dataclass
 class RemapReport:
     """apply_osd_feedback's outcome."""
 
@@ -312,6 +328,197 @@ def repair(sinfo: StripeInfo, ec, store, hinfo: HashInfo,
     return RepairReport(scrub=report,
                         repaired={s: rec[s] for s in bad},
                         reencode_verified=True, crc_verified=True)
+
+
+# -- stage 2b: batched repair (one device call per erasure pattern) ------
+
+def repair_batched(sinfo: StripeInfo, ec, stores, hinfos, *,
+                   retry_policy: Optional[RetryPolicy] = None,
+                   clock=None, write_back: bool = True,
+                   device: Optional[bool] = None) -> BatchRepairReport:
+    """Repair MANY same-geometry objects with one fused device call
+    per erasure-pattern batch.
+
+    The per-object ``repair`` loop crosses host↔device once per object
+    (and its decode and re-encode are separate dispatches); at fleet
+    scale the dispatch latency dominates the math.  Here every object
+    is scrub-classified on the host exactly as before (CRC gating
+    unchanged), the damaged ones are grouped by (read plan, erased
+    set, shard length), each group's stripes are stacked into ONE
+    HBM-resident array, and a single fused decode→re-encode program
+    (codes/engine.py::fused_repair_call, cached per pattern) produces
+    both the rebuilt shards and the re-encode proof in one dispatch.
+    Results are byte-identical to per-object ``repair`` — the fused
+    program composes the same plugin decode/encode surfaces — and
+    both write-back gates (re-encode byte identity, HashInfo CRC)
+    still run per object on the host.
+
+    Raises UnrecoverableError on the first object past the failure
+    budget (before any device work) and ScrubError if any object
+    fails a write-back gate (objects that passed are healed first).
+
+    ``device``: None (default) auto-selects — the fused device path
+    unless the fallback policy sits on the numpy tier; False forces
+    the grouped HOST path (same grouping, zero jax dispatches — the
+    bench's tunnel-down error path must never touch a wedged device).
+    """
+    stores = [ensure_store(s, chunk_size=sinfo.chunk_size)
+              for s in stores]
+    hinfos = list(hinfos)
+    if len(stores) != len(hinfos):
+        raise ValueError(f"{len(stores)} stores != {len(hinfos)} "
+                         f"HashInfos")
+    from ..codes.engine import fused_repair_call
+    from ..codes.techniques import _numpy_tier
+    from ..utils.perf import global_perf
+    n = ec.get_chunk_count()
+    k = ec.get_data_chunk_count()
+    mapping = stripe_mod._chunk_mapping(ec)
+    reports: List[Optional[RepairReport]] = [None] * len(stores)
+    groups: Dict[tuple, List[int]] = {}
+    scrubs: List[ScrubReport] = []
+    for i, (store, hinfo) in enumerate(zip(stores, hinfos)):
+        rep = deep_scrub(sinfo, ec, store, hinfo,
+                         retry_policy=retry_policy, clock=clock)
+        scrubs.append(rep)
+        if rep.is_clean:
+            reports[i] = RepairReport(scrub=rep, reencode_verified=True,
+                                      crc_verified=True)
+            continue
+        n_stripes = rep.shard_length // sinfo.chunk_size
+
+        def _unrecoverable(cause=None, rep=rep, n_stripes=n_stripes):
+            return UnrecoverableError(
+                f"object {i}: {len(rep.bad)} shards lost/corrupt exceed "
+                f"the failure budget of this "
+                f"{ec.get_data_chunk_count()}+"
+                f"{ec.get_coding_chunk_count()} code",
+                shards=rep.bad,
+                extents=unrecoverable_extents(sinfo, ec, rep.bad,
+                                              n_stripes),
+                cause=cause)
+
+        if len(rep.clean) < k:
+            raise _unrecoverable()
+        try:
+            # feasibility oracle only — the fused call stacks EVERY
+            # clean shard, because the re-encode half needs all k data
+            # chunks (lrc's minimum plan can skip clean data shards
+            # outside the local group) and the host gates read every
+            # shard regardless; decode output is byte-identical at any
+            # valid availability
+            ec.minimum_to_decode(set(rep.bad), set(rep.clean))
+        except (IOError, ValueError) as e:
+            raise _unrecoverable(cause=e) from e
+        key = (tuple(rep.clean), tuple(rep.bad), rep.shard_length)
+        groups.setdefault(key, []).append(i)
+
+    perf = global_perf()
+    device_calls = 0
+    host_batches = 0
+    gate_failures: List[str] = []
+    for (available, erased, shard_len), members in groups.items():
+        n_stripes = shard_len // sinfo.chunk_size
+        reads_by_obj: List[Dict[int, bytes]] = []
+        stacks = []
+        for i in members:
+            reads = {s: retry_call(stores[i].read, s,
+                                   policy=retry_policy, clock=clock)
+                     for s in available}
+            reads_by_obj.append(reads)
+            stacks.append(np.stack(
+                [np.frombuffer(reads[s], dtype=np.uint8).reshape(
+                    n_stripes, sinfo.chunk_size) for s in available],
+                axis=1))
+        stack = np.concatenate(stacks, axis=0)  # (B*stripes, na, C)
+        aidx = {s: t for t, s in enumerate(available)}
+        eidx = {s: t for t, s in enumerate(erased)}
+        use_device = device if device is not None else not _numpy_tier()
+        if not use_device:
+            # numpy tier: still grouped (one host pass per pattern),
+            # zero device traffic by policy
+            rec_arr = np.asarray(ec.decode_chunks_batch(
+                stack, available, erased))
+            cols = [stack[:, aidx[mapping[c]], :] if mapping[c] in aidx
+                    else rec_arr[:, eidx[mapping[c]], :]
+                    for c in range(k)]
+            parity = np.asarray(ec.encode_chunks_batch(
+                np.ascontiguousarray(np.stack(cols, axis=1))))
+            host_batches += 1
+            perf.inc("scrub_batch_host_calls")
+        else:
+            import jax
+            fn = fused_repair_call(ec, available, erased)
+            rec_dev, par_dev = fn(jax.device_put(stack))
+            rec_arr = np.asarray(rec_dev)
+            parity = np.asarray(par_dev)
+            device_calls += 1
+            perf.inc("scrub_batch_device_calls")
+        perf.inc("scrub_batch_stripes", stack.shape[0])
+
+        for t, i in enumerate(members):
+            lo = t * n_stripes
+            rec = {s: np.ascontiguousarray(
+                rec_arr[lo:lo + n_stripes, eidx[s], :]).tobytes()
+                for s in erased}
+            current: Dict[int, bytes] = {}
+            for s in range(n):
+                if s in rec:
+                    current[s] = rec[s]
+                elif s in aidx:
+                    current[s] = reads_by_obj[t][s]
+                else:
+                    current[s] = retry_call(stores[i].read, s,
+                                            policy=retry_policy,
+                                            clock=clock)
+            # re-encode gate: fused parity vs surviving/recovered
+            # shards (data shards are assembled FROM current, so the
+            # byte-identity obligation reduces to the parity rows —
+            # exactly what the per-object gate checks effectively)
+            mismatch = []
+            for j in range(ec.get_coding_chunk_count()):
+                s = mapping[k + j]
+                expect = np.ascontiguousarray(
+                    parity[lo:lo + n_stripes, j, :]).tobytes()
+                if expect != current[s]:
+                    mismatch.append(s)
+            if mismatch:
+                gate_failures.append(
+                    f"object {i}: re-encode mismatch on shards "
+                    f"{mismatch}")
+                reports[i] = RepairReport(scrub=scrubs[i])
+                continue
+            crcs = ceph_crc32c_batch(
+                [CRC_SEED] * n,
+                np.stack([np.frombuffer(current[s], dtype=np.uint8)
+                          for s in range(n)]))
+            crc_bad = [s for s in range(n)
+                       if int(crcs[s]) != hinfos[i].get_chunk_hash(s)]
+            if crc_bad:
+                gate_failures.append(
+                    f"object {i}: crc gate failed on shards {crc_bad}")
+                reports[i] = RepairReport(scrub=scrubs[i])
+                continue
+            if write_back:
+                for s in erased:
+                    stores[i].write(s, rec[s])
+            reports[i] = RepairReport(scrub=scrubs[i], repaired=rec,
+                                      reencode_verified=True,
+                                      crc_verified=True)
+    if groups:
+        dout("ec", 5, f"repair_batched: {len(stores)} objects, "
+                      f"{len(groups)} pattern batches, "
+                      f"{device_calls} device calls")
+    out = BatchRepairReport(reports=reports,  # type: ignore[arg-type]
+                            pattern_batches=len(groups),
+                            device_calls=device_calls,
+                            host_batches=host_batches)
+    if gate_failures:
+        raise ScrubError(
+            "batched repair verification failed — refusing to write "
+            "those objects back: " + "; ".join(gate_failures),
+            shards=[])
+    return out
 
 
 # -- stage 3: OSD feedback / CRUSH remap ---------------------------------

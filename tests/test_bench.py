@@ -175,17 +175,75 @@ def test_degraded_workload_rejects_over_budget_args():
 
 def test_bench_degraded_rows_config():
     """bench.py's recovery rows stay within the failure budget and
-    cover 0 / 1 / m-combined fault levels."""
+    cover 0 / 1 / m-combined fault levels plus the batched repair
+    row (ISSUE 3: the scrub batching is measured every round)."""
     import bench
     names = [n for n, _ in bench.DEGRADED_ROWS]
     assert names == ["rs_k8_m3_scrub_e0", "rs_k8_m3_degraded_e1",
-                     "rs_k8_m3_degraded_e2_c1"]
+                     "rs_k8_m3_degraded_e2_c1",
+                     "rs_k8_m3_repair_batched_e1"]
+    workloads = set()
     for _, extra in bench.DEGRADED_ROWS:
         args = bench.DEGRADED_COMMON + ["--iterations", "1"] + extra
         b = ErasureCodeBench()
         b.setup(args)                  # parses cleanly
+        workloads.add(b.args.workload)
         e = b.args.erasures + b.args.corruptions
         assert e <= 3                  # m=3 budget
+    assert workloads == {"degraded", "repair-batched"}
+
+
+def test_repair_batched_workload():
+    """The repair-batched workload heals --batch objects through the
+    fused per-pattern device path and reports the batching proof
+    (device calls == pattern batches, both far below object count)."""
+    res = run_bench(["--plugin", "jerasure",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--batch", "6",
+                     "--iterations", "1",
+                     "--workload", "repair-batched", "--erasures", "1",
+                     "--device", "jax"])
+    assert res["workload"] == "repair-batched"
+    assert res["gbps"] > 0
+    assert res["pattern_batches"] >= 1
+    assert res["device_calls"] + res["host_batches"] \
+        == res["pattern_batches"]
+    assert res["pattern_batches"] <= 4 < 6  # grouped, not per-object
+
+
+def test_repair_batched_workload_host_pin():
+    """--device host keeps the whole row on the grouped host path —
+    zero jax dispatches, so the tunnel-down bench error path can run
+    it against a wedged device."""
+    res = run_bench(["--plugin", "jerasure",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--batch", "4",
+                     "--iterations", "1",
+                     "--workload", "repair-batched", "--erasures", "1",
+                     "--device", "host"])
+    assert res["device_calls"] == 0
+    assert res["host_batches"] == res["pattern_batches"] >= 1
+
+
+def test_bench_metric_version_and_slice_field(monkeypatch):
+    """Headline hygiene (ADVICE round 5): the emitted line carries the
+    metric_version marker, and the headline value comes from the
+    carry-chain candidates while the slice-chain number rides in the
+    separate slice_gbps field."""
+    import bench
+    assert bench.METRIC_VERSION == 2
+    monkeypatch.setattr(bench, "_degraded_rows",
+                        lambda iterations, host_only=False: {})
+    err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
+    assert err["metric_version"] == bench.METRIC_VERSION
+    # the official decode rows route shec through the packed slice
+    # chain and clay through packed carry (MXU composites are not
+    # DCE-opaque, so slice would be fiction there)
+    rows = dict(bench.DECODE_ROWS)
+    assert "slice" in rows["shec_k6_m3_c2_e1"]
+    assert "packed" in rows["shec_k6_m3_c2_e1"]
+    assert "carry" in rows["clay_k8_m4_d11_e1"]
+    assert "packed" in rows["clay_k8_m4_d11_e1"]
 
 
 def test_bench_last_good_roundtrip(tmp_path, monkeypatch):

@@ -1,0 +1,267 @@
+"""Unified device-resident decode engine (ISSUE 3).
+
+Pins, on CPU:
+- byte-identity of the GENERALIZED packed Pallas kernel (row-tile
+  padding + masked writeback) against the numpy ground truth for the
+  composite decode matrices shec/clay/lrc actually build, across >= 20
+  seeded erasure patterns (interpreter mode — the same kernel compiles
+  for TPU);
+- the engine-selection table: shec/lrc composites route to the Pallas
+  packed kernel on a Pallas-capable backend, clay's large composite to
+  the MXU path, everything to XLA/numpy on the lower tiers;
+- the cross-call pattern cache: warm hits across fresh plugin
+  instances, a bounded build (== jit recompile) count, and the
+  recompile-budget guard firing on unbounded churn.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ceph_tpu.codes.engine import (
+    PatternCache,
+    global_pattern_cache,
+    pattern_key,
+    set_global_pattern_cache,
+)
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+from ceph_tpu.ops import regionops
+from ceph_tpu.ops.pallas_gf import (
+    MXU_MATRIX_MIN,
+    apply_matrix_pallas,
+    apply_matrix_pallas_packed,
+    pack_chunks,
+    pallas_matrix_packed_supported,
+    pallas_matrix_padded_supported,
+    select_matrix_engine,
+    unpack_chunks,
+)
+from ceph_tpu.ops.xla_ops import matrix_to_static
+
+
+def _factory(plugin, profile):
+    return ErasureCodePluginRegistry.instance().factory(plugin,
+                                                        dict(profile))
+
+
+def _encoded_stack(ec, batch, chunk_size, seed):
+    """(batch, n, C) full chunk set at the plugin's shard positions."""
+    rng = np.random.default_rng(seed)
+    n = ec.get_chunk_count()
+    k = ec.get_data_chunk_count()
+    data = rng.integers(0, 256, (batch, k, chunk_size), dtype=np.uint8)
+    parity = np.asarray(ec.encode_chunks_batch(data))
+    mapping = ec.get_chunk_mapping() or list(range(k))
+    dpos = list(mapping)[:k]
+    ppos = [p for p in range(n) if p not in set(dpos)]
+    allc = np.empty((batch, n, chunk_size), np.uint8)
+    allc[:, dpos] = data
+    allc[:, ppos] = parity
+    return allc
+
+
+def _seeded_patterns(ec, count, seed, max_erasures):
+    """``count`` decodable erasure tuples, seeded."""
+    rng = np.random.default_rng(seed)
+    n = ec.get_chunk_count()
+    pats = []
+    while len(pats) < count:
+        ne = int(rng.integers(1, max_erasures + 1))
+        pat = tuple(sorted(int(v) for v in
+                           rng.choice(n, size=ne, replace=False)))
+        try:
+            ec.minimum_to_decode(set(pat), set(range(n)) - set(pat))
+        except IOError:
+            continue
+        if pat not in pats:
+            pats.append(pat)
+    return pats
+
+
+# (plugin, profile, chunk C, patterns drawn, max erasures) — 8+6+6 =
+# 20 seeded patterns across the three composite plugins.  C=2048 puts
+# shec/lrc on the PADDED row tiles (16 u8 rows / 4 u32 rows, off the
+# 32/8-row native tiles); clay's sub-chunk split leaves 1 packed row
+# per composite input row at C=4096 (sub=8) — padded 1→8.
+CASES = [
+    ("shec", {"k": "6", "m": "3", "c": "2"}, 2048, 8, 2),
+    ("clay", {"k": "4", "m": "2", "d": "5"}, 4096, 6, 2),
+    ("lrc", {"k": "4", "m": "2", "l": "3"}, 2048, 6, 1),
+]
+
+
+@pytest.mark.parametrize("plugin,profile,C,count,max_e",
+                         CASES, ids=[c[0] for c in CASES])
+def test_composite_pallas_byte_identity(plugin, profile, C, count, max_e,
+                                        monkeypatch):
+    """Interpret-mode Pallas (packed, padded) == numpy ground truth,
+    through the plugin's OWN packed composite decode path, per seeded
+    pattern.  On CPU the packed dispatch would route to XLA; the
+    monkeypatch pins it to the interpreter-mode Pallas kernel — the
+    same kernel body Mosaic compiles on TPU."""
+    import ceph_tpu.ops.pallas_gf as pg
+    monkeypatch.setattr(
+        pg, "apply_matrix_packed_best",
+        lambda words, mt: pg.apply_matrix_pallas_packed(words, mt, True))
+    ec = _factory(plugin, profile)
+    n = ec.get_chunk_count()
+    chunk = ec.get_chunk_size(ec.get_data_chunk_count() * C) \
+        if plugin == "clay" else C
+    if plugin == "clay":
+        assert (chunk // 512) % ec.sub_chunk_no == 0
+    allc = _encoded_stack(ec, 2, chunk, seed=hash(plugin) % 1000)
+    pats = _seeded_patterns(ec, count, seed=len(plugin),
+                            max_erasures=max_e)
+    assert len(pats) == count
+    for pat in pats:
+        avail = tuple(i for i in range(n) if i not in pat)
+        survivors = np.ascontiguousarray(allc[:, list(avail)])
+        ref = np.asarray(ec.decode_chunks_batch(survivors, avail, pat))
+        got = unpack_chunks(np.asarray(ec.decode_chunks_packed_jax(
+            jnp.asarray(pack_chunks(survivors)), avail, pat)))
+        assert np.array_equal(got, ref), (plugin, pat)
+
+
+def test_padded_packed_kernel_matches_groundtruth_odd_rows():
+    """The row-tile generalization itself: matrices applied to chunks
+    whose packed row counts (1, 3, 4, 5) all sit OFF the native u32
+    sublane tile — pad + masked writeback must be byte-exact, and the
+    bytes-layout padded kernel must agree too."""
+    rng = np.random.default_rng(7)
+    for rows in (1, 3, 4, 5):
+        C = rows * 4 * 128
+        M = rng.integers(0, 256, (5, 9))
+        data = rng.integers(0, 256, (2, 9, C), dtype=np.uint8)
+        ref = regionops.matrix_encode(data, M, 8)
+        ms = matrix_to_static(M)
+        got_b = np.asarray(apply_matrix_pallas(data, ms, True))
+        assert np.array_equal(got_b, ref), rows
+        got_p = np.asarray(apply_matrix_pallas_packed(
+            jnp.asarray(pack_chunks(data)), ms, True))
+        assert np.array_equal(unpack_chunks(got_p), ref), rows
+
+
+def test_engine_selection_table():
+    """The Pallas→XLA→numpy selection table (docs/PERF.md), asserted
+    directly: shec/lrc-sized composites ride the packed Pallas kernel
+    when the device tier is pallas, clay's large composite rides the
+    MXU, and the lower tiers route to XLA / numpy."""
+    small = matrix_to_static(np.ones((3, 7), dtype=np.int64))
+    big = tuple(tuple(1 for _ in range(704)) for _ in range(64))
+    assert sum(v != 0 for row in big for v in row) >= MXU_MATRIX_MIN
+    shape_packed = (4, 7, 4, 128)
+    # pallas tier
+    assert select_matrix_engine(shape_packed, small, 8, packed=True,
+                                engine="pallas") == "pallas"
+    assert select_matrix_engine((4, 704, 4, 128), big, 8, packed=True,
+                                engine="pallas") == "mxu"
+    assert select_matrix_engine((4, 704, 2048), big, 8,
+                                engine="pallas") == "mxu"
+    # bytes layout, non-tiling rows -> padded pallas (not xla)
+    assert pallas_matrix_padded_supported((4, 7, 2048), 8)
+    assert select_matrix_engine((4, 7, 2048), small, 8,
+                                engine="pallas") == "pallas"
+    # lane-ragged chunk: no pallas variant fits
+    assert not pallas_matrix_padded_supported((4, 7, 1000), 8)
+    assert select_matrix_engine((4, 7, 1000), small, 8,
+                                engine="pallas") == "xla"
+    # lower tiers
+    assert select_matrix_engine(shape_packed, small, 8, packed=True,
+                                engine="xla") == "xla"
+    assert select_matrix_engine(shape_packed, small, 8, packed=True,
+                                engine="numpy") == "numpy"
+    assert pallas_matrix_packed_supported(shape_packed)
+
+
+def test_plugins_route_composites_to_pallas():
+    """Engine-selection assertion of the acceptance criterion: the
+    composite matrices shec and clay ACTUALLY build route to a device
+    kernel (Pallas for shec's plan, MXU for clay's big composite) on a
+    Pallas-tier backend, for the bench shapes."""
+    shec = _factory("shec", {"k": "6", "m": "3", "c": "2"})
+    n = shec.get_chunk_count()
+    avail = tuple(i for i in range(n) if i != 1)
+    plan = shec.tcache.get_plan(shec.matrix, shec.k, shec.w,
+                                frozenset(avail), frozenset((1,)))
+    _, ms, _ = shec._plan_static(plan)
+    # bench shape: 128 KiB chunks -> 256 packed rows
+    assert select_matrix_engine((32, len(ms[0]), 256, 128), ms, 8,
+                                packed=True, engine="pallas") == "pallas"
+
+    clay = _factory("clay", {"k": "8", "m": "4", "d": "11"})
+    avail = tuple(i for i in range(1, 12))
+    _, cms = clay._decode_composite(avail, (0,))
+    assert len(cms) == clay.sub_chunk_no  # 64 x 704 composite
+    assert len(cms[0]) == 11 * clay.sub_chunk_no
+    assert select_matrix_engine((16, len(cms[0]), 4, 128), cms, 8,
+                                packed=True, engine="pallas") == "mxu"
+
+
+def test_pattern_cache_warm_hits_and_bounded_recompiles():
+    """Cross-call cache: a FRESH instance with the same profile hits
+    the warm entries (no new composite builds, hence no new jit
+    traces), and repeated decodes never grow the build count."""
+    cache = PatternCache()
+    prev = set_global_pattern_cache(cache)
+    try:
+        profile = {"k": "6", "m": "3", "c": "2"}
+        allc = None
+        for round_i in range(3):
+            ec = _factory("shec", profile)   # fresh instance each time
+            if allc is None:
+                allc = _encoded_stack(ec, 2, 2048, seed=3)
+            n = ec.get_chunk_count()
+            for pat in [(0,), (4,), (0, 7)]:
+                avail = tuple(i for i in range(n) if i not in pat)
+                ec.decode_chunks_batch(
+                    np.ascontiguousarray(allc[:, list(avail)]),
+                    avail, pat)
+            if round_i == 0:
+                first = cache.stats()
+                assert first["builds"] > 0
+        final = cache.stats()
+        assert final["builds"] == first["builds"], \
+            "fresh instances must not rebuild composites"
+        assert final["hits"] > 0
+        assert final["evictions"] == 0
+    finally:
+        set_global_pattern_cache(prev)
+
+
+def test_pattern_cache_recompile_budget_guard():
+    """The recompile-count guard: unbounded pattern churn trips a loud
+    RuntimeError instead of a silent per-call compile storm."""
+    cache = PatternCache(recompile_budget=3)
+    for i in range(3):
+        cache.get_or_build(("k", i), lambda i=i: i)
+    with pytest.raises(RuntimeError, match="recompile budget"):
+        cache.get_or_build(("k", 99), lambda: 99)
+    # warm hits never count against the budget
+    for i in range(3):
+        assert cache.get_or_build(("k", i), lambda: None) == i
+
+
+def test_pattern_cache_eviction_bounds_memory():
+    cache = PatternCache(max_patterns=4)
+    for i in range(10):
+        cache.get_or_build(("p", i), lambda i=i: i)
+    st = cache.stats()
+    assert st["patterns"] == 4
+    assert st["evictions"] == 6
+
+
+def test_pattern_key_is_profile_scoped():
+    """Two instances, same profile -> same key; different profile ->
+    different key (patterns must never leak across geometries)."""
+    a = _factory("shec", {"k": "6", "m": "3", "c": "2"})
+    b = _factory("shec", {"k": "6", "m": "3", "c": "2"})
+    c = _factory("shec", {"k": "4", "m": "3", "c": "2"})
+    ka = pattern_key(a, "x", (0, 1), (2,))
+    assert ka == pattern_key(b, "x", (0, 1), (2,))
+    assert ka != pattern_key(c, "x", (0, 1), (2,))
+    assert ka != pattern_key(a, "y", (0, 1), (2,))
+
+
+def test_global_cache_is_process_wide():
+    assert global_pattern_cache() is global_pattern_cache()

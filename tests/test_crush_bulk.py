@@ -595,6 +595,39 @@ def test_bulk_set_firstn_ec_shape_and_chained():
     pin(b, 1, 2, weight=w)
 
 
+def test_bulk_transitional_vary_r_stable_tunables_gate():
+    """Map-level chooseleaf_vary_r >= 2 is a legal upstream
+    TRANSITIONAL value (host semantics: sub_r = r >> (vary_r - 1));
+    the fused leaf ladders hardcode vary_r == 1, so a falsy-only guard
+    let those maps through to silent divergence with no need_host flag
+    (ADVICE round 5).  Exact-value rejection, mirrored for
+    chooseleaf_stable > 1 — and the host engine keeps serving both."""
+    import dataclasses
+    b, root = build(3, 2)
+    b.add_rule(0, STEPS["chooseleaf_firstn"](root))
+    b.map.tunables = dataclasses.replace(b.map.tunables,
+                                         chooseleaf_vary_r=2)
+    with pytest.raises(ValueError, match="tunables"):
+        bulk.bulk_do_rule(b.map, 0, np.arange(4), 3)
+    b.map.tunables = dataclasses.replace(b.map.tunables,
+                                         chooseleaf_vary_r=1,
+                                         chooseleaf_stable=2)
+    with pytest.raises(ValueError, match="tunables"):
+        bulk.bulk_do_rule(b.map, 0, np.arange(4), 3)
+    # the exact host mapper serves both profiles (engine=host route)
+    for vary_r, stable in ((2, 1), (1, 2)):
+        b.map.tunables = dataclasses.replace(
+            b.map.tunables, chooseleaf_vary_r=vary_r,
+            chooseleaf_stable=stable)
+        assert crush_do_rule(b.map, 0, 0, 3)
+    # jewel values still fuse
+    b.map.tunables = dataclasses.replace(b.map.tunables,
+                                         chooseleaf_vary_r=1,
+                                         chooseleaf_stable=1)
+    out, cnt = bulk.bulk_do_rule(b.map, 0, np.arange(4), 3)
+    assert out.shape == (4, 3)
+
+
 def test_bulk_set_vary_r_stable_overrides_gate():
     from ceph_tpu.crush.types import (CRUSH_RULE_SET_CHOOSELEAF_STABLE,
                                       CRUSH_RULE_SET_CHOOSELEAF_VARY_R)

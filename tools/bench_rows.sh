@@ -50,15 +50,33 @@ run_row "north star encode, packed, slice chain (roofline-honest)" \
     -s $((1<<20)) --batch 64 --loop 1024 --layout packed \
     --chain slice --json
 
-run_row "row 3: shec k=6 m=3 c=2 single-chunk decode" \
+run_row "row 3: shec k=6 m=3 c=2 single-chunk decode (unified engine: packed Pallas, slice chain)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p shec -P k=6 -P m=3 -P c=2 -s $((6*131072)) \
+    --workload decode -e 1 --batch 32 --loop 256 \
+    --layout packed --chain slice --json
+
+run_row "row 3b: shec decode, pre-engine shape (bytes/carry, trend continuity)" \
     python -m ceph_tpu.bench.erasure_code_benchmark \
     -p shec -P k=6 -P m=3 -P c=2 -s $((6*131072)) \
     --workload decode -e 1 --batch 32 --loop 256 --json
 
-run_row "row 4: clay k=8 m=4 d=11 decode (1 erasure)" \
+run_row "row 4: clay k=8 m=4 d=11 decode (1 erasure; packed, carry — MXU composite is not DCE-opaque)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p clay -P k=8 -P m=4 -P d=11 -s $((1<<20)) \
+    --workload decode -e 1 --batch 16 --loop 64 \
+    --layout packed --chain carry --json
+
+run_row "row 4a: clay decode, pre-engine shape (bytes/carry, trend continuity)" \
     python -m ceph_tpu.bench.erasure_code_benchmark \
     -p clay -P k=8 -P m=4 -P d=11 -s $((1<<20)) \
     --workload decode -e 1 --batch 16 --loop 64 --json
+
+run_row "row 6: batched scrub repair (one fused dispatch per erasure-pattern batch)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<18)) --workload repair-batched -e 1 --batch 16 \
+    --iterations 3 --json
 
 run_row "row 4b: jerasure RS decode, packed layout" \
     python -m ceph_tpu.bench.erasure_code_benchmark \

@@ -6,7 +6,10 @@ cd "$(dirname "$0")/.."
 # Static gate first: tpu-lint must be clean before anything compiles.
 # (The same gate runs inside tier-1 as tests/test_tpu_lint.py; running
 # it here too makes a lint regression fail in seconds, not minutes.)
-python tools/tpu_lint.py ceph_tpu/ tools/ || exit 1
+# bench.py rides along so the round-artifact driver is linted too —
+# everything under ceph_tpu/ and tools/ (including any new files) is
+# already covered by the directory walks.
+python tools/tpu_lint.py ceph_tpu/ tools/ bench.py || exit 1
 # Chaos/scrub end-to-end smoke (docs/ROBUSTNESS.md): a recoverable
 # fault mix must heal (rc 0) and a past-budget mix must fail with the
 # structured unrecoverable report (rc 2) — in seconds, before the full
