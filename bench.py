@@ -118,6 +118,17 @@ DEGRADED_ROWS = [
     ("rs_k8_m3_repair_batched_e1",
      ["--workload", "repair-batched", "--device", "jax",
       "--size", str(1 << 18), "--batch", "16", "-e", "1"]),
+    # recovery under live OSDMap churn (ISSUE 4): the epoch-aware
+    # orchestrator drives the same batched repair to durable
+    # convergence while a seeded MapChurn advances the map every 2
+    # pattern-batch dispatches — epoch fencing, re-plans, regroups and
+    # the intent journal all inside the timed loop, so this row tracks
+    # the fencing overhead against the still-map repair-batched row.
+    # Host-only error path rides the same --device last-wins override.
+    ("rs_k8_m3_recovery_churn",
+     ["--workload", "recovery-churn", "--device", "jax",
+      "--size", str(1 << 18), "--batch", "8", "-e", "1",
+      "--churn-every", "2"]),
 ]
 
 

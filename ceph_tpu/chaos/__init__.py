@@ -1,20 +1,30 @@
 """ceph_tpu.chaos — deterministic fault injection.
 
 Seeded, composable injectors that damage stored shards (erasure,
-bit-flips, truncation, stripe zeroing) and the read path (transient
-backend errors), over an ObjectStore-like ShardStore.  The scrub
-pipeline (ceph_tpu.scrub), the fuzz suites, the degraded benchmark
-and tools/scrub_demo.py all drive the same injectors, so every
-robustness claim replays from a (seed, injector list) pair.  See
-docs/ROBUSTNESS.md.
+bit-flips, truncation, stripe zeroing, torn write-backs) and the read
+path (transient backend errors), over an ObjectStore-like ShardStore —
+plus the orchestrator-level adversaries (named crash sites, seeded
+OSDMap churn through epoch-ordered incrementals) the recovery
+orchestrator must survive.  The scrub pipeline (ceph_tpu.scrub), the
+recovery orchestrator (ceph_tpu.recovery), the fuzz/torture suites,
+the degraded benchmark rows and tools/{scrub,recovery}_demo.py all
+drive the same adversaries, so every robustness claim replays from a
+(seed, scenario) pair.  See docs/ROBUSTNESS.md.
 """
 
+from .adversaries import (  # noqa: F401
+    CRASH_SITES,
+    CrashPoint,
+    InjectedCrash,
+    MapChurn,
+)
 from .injectors import (  # noqa: F401
     BitFlip,
     Compose,
     Fault,
     Injector,
     ShardErasure,
+    TornWrite,
     TransientErrors,
     Truncate,
     ZeroStripe,

@@ -1,0 +1,181 @@
+"""Orchestrator-level adversaries: crashes and OSDMap churn.
+
+The shard-byte injectors (chaos/injectors.py) damage what is STORED;
+these damage the recovery PROCESS itself — the two failure classes the
+reference survives through its PG log / recovery-reservation machinery
+and the mon's epoch-ordered map publication:
+
+- ``CrashPoint``   — raise InjectedCrash deterministically at a named
+  pipeline crash site (the recovery orchestrator visits every site by
+  name; tools/recovery_demo.py --list-sites prints the catalogue).
+  The "process died here" model: the exception unwinds the
+  orchestrator, and only what the intent journal + stores carry
+  survives into the resumed instance.
+- ``MapChurn``     — a seeded sequence of mark_down/out, revive and
+  reweight events applied as proper epoch-ordered Incrementals
+  (crush/incremental.py) between pipeline stages, so every repair the
+  orchestrator planned against epoch e can find the map at e+n by the
+  time it dispatches or writes back.  ``max_down`` bounds concurrent
+  churn-downed OSDs (the thrasher's "never exceed the failure budget"
+  discipline); everything replays from (seed, params).
+
+Both are plain state machines over injected randomness — no wall
+clock, no threads — so any (seed, scenario) pair replays
+byte-identically from the tests, the torture suite, the bench's
+recovery-churn row, or tools/recovery_demo.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.errors import InjectedCrash
+
+# The crash-site catalogue (docs/ROBUSTNESS.md): every named point the
+# recovery orchestrator visits, in pipeline order.  A CrashPoint can
+# target any of them; the torture suite sweeps them all.
+CRASH_SITES: Tuple[str, ...] = (
+    "plan.after_scrub",          # ops planned, nothing dispatched
+    "dispatch.before_decode",    # a pattern batch about to dispatch
+    "writeback.after_intent",    # intent journaled, zero bytes written
+    "writeback.after_write",     # >=1 shard written, op not committed
+    "writeback.before_commit",   # all shards written, commit not logged
+    "writeback.after_commit",    # committed, intent not yet cleared
+)
+
+
+@dataclass
+class CrashPoint:
+    """Deterministic named-site crash: raises InjectedCrash the
+    ``at_hit``-th time ``visit(site)`` reaches ``site``, then disarms
+    (so the resumed orchestrator runs the same code path to
+    completion).  ``site=None`` never fires (the null adversary)."""
+
+    site: Optional[str] = None
+    at_hit: int = 1
+    fired: bool = False
+    hits: Dict[str, int] = field(default_factory=dict)
+
+    def visit(self, site: str) -> None:
+        self.hits[site] = self.hits.get(site, 0) + 1
+        if self.fired or self.site is None or site != self.site:
+            return
+        if self.hits[site] >= self.at_hit:
+            self.fired = True
+            raise InjectedCrash(site, self.hits[site])
+
+
+@dataclass
+class MapChurn:
+    """Seeded OSDMap churn driven through epoch-ordered incrementals.
+
+    ``step(osdmap, stage)`` is the interleave point: the orchestrator
+    (and repair_batched's on_batch hook) calls it between pipeline
+    stages; the churn decides — deterministically from its seed —
+    whether to fire an event there, builds an Incremental at epoch+1,
+    applies it, and records what it did.
+
+    Event kinds: ``down`` (mark an up+in OSD down AND out — the
+    scrub-feedback shape that remaps CRUSH), ``revive`` (bring a
+    churn-downed OSD back up+in), ``reweight`` (nudge a live OSD's
+    weight within [IN/2, IN] — remaps without capacity loss).
+    ``max_down`` bounds concurrent churn-downs; ``fire_every`` makes
+    the cadence deterministic (every Nth step) instead of
+    probabilistic ``p_fire``; ``stages`` restricts firing to named
+    stages; ``avoid_osds`` protects OSDs from being downed (tests pin
+    the victim set elsewhere)."""
+
+    seed: int = 0
+    max_down: int = 1
+    p_fire: float = 0.5
+    fire_every: Optional[int] = None
+    max_events: Optional[int] = None
+    stages: Optional[Sequence[str]] = None
+    avoid_osds: Sequence[int] = ()
+    # runtime state (all derived deterministically from the seed)
+    steps: int = 0
+    events: List[dict] = field(default_factory=list)
+    incrementals: List[object] = field(default_factory=list)
+    downed: List[int] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self._rng = np.random.default_rng(self.seed)
+
+    @property
+    def epochs_advanced(self) -> int:
+        return len(self.events)
+
+    def step(self, osdmap, stage: str = "") -> Optional[object]:
+        """Maybe fire ONE churn event against ``osdmap``; returns the
+        applied Incremental (also appended to ``self.incrementals``)
+        or None."""
+        from ..crush.incremental import Incremental, apply_incremental, \
+            get_epoch
+        self.steps += 1
+        if self.stages is not None and stage not in self.stages:
+            return None
+        if self.max_events is not None and \
+                len(self.events) >= self.max_events:
+            return None
+        if self.fire_every is not None:
+            if self.steps % self.fire_every != 0:
+                return None
+        elif float(self._rng.random()) >= self.p_fire:
+            return None
+        ev = self._draw_event(osdmap)
+        if ev is None:
+            return None
+        kind, payload = ev
+        inc = Incremental(epoch=get_epoch(osdmap) + 1, **payload)
+        apply_incremental(osdmap, inc)
+        self.events.append({"kind": kind, "stage": stage,
+                            "epoch": inc.epoch,
+                            "detail": self._detail(kind, payload)})
+        self.incrementals.append(inc)
+        return inc
+
+    @staticmethod
+    def _detail(kind: str, payload: dict) -> str:
+        if kind == "reweight":
+            (osd, w), = payload["new_weight"].items()
+            return f"osd.{osd} weight={w:#x}"
+        osd = next(iter(payload["new_state"]))
+        return f"osd.{osd}"
+
+    def _draw_event(self, osdmap):
+        from ..crush.incremental import CEPH_OSD_UP
+        from ..crush.osdmap import IN_WEIGHT
+        avoid = set(int(o) for o in self.avoid_osds)
+        live = [o for o in range(osdmap.max_osd)
+                if osdmap.is_up(o) and not osdmap.is_out(o)
+                and o not in avoid]
+        kinds = []
+        if self.downed:
+            kinds.append("revive")
+        if len(self.downed) < self.max_down and live:
+            kinds.append("down")
+        if live:
+            kinds.append("reweight")
+        if not kinds:
+            return None
+        kind = kinds[int(self._rng.integers(0, len(kinds)))]
+        if kind == "down":
+            osd = int(live[int(self._rng.integers(0, len(live)))])
+            self.downed.append(osd)
+            # xor UP marks the (up) osd down; weight 0 marks it out
+            return "down", {"new_state": {osd: CEPH_OSD_UP},
+                            "new_weight": {osd: 0}}
+        if kind == "revive":
+            osd = self.downed.pop(
+                int(self._rng.integers(0, len(self.downed))))
+            return "revive", {"new_state": {osd: CEPH_OSD_UP},
+                              "new_weight": {osd: IN_WEIGHT}}
+        osd = int(live[int(self._rng.integers(0, len(live)))])
+        w = int(self._rng.integers(IN_WEIGHT // 2, IN_WEIGHT + 1))
+        return "reweight", {"new_weight": {osd: w}}
+
+
+__all__ = ["CRASH_SITES", "CrashPoint", "InjectedCrash", "MapChurn"]

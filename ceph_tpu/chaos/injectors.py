@@ -20,7 +20,15 @@ Fault kinds (the classification the scrub pipeline must recover):
                 misdirected full-stripe write),
 - transient   — the shard's next N reads raise TransientBackendError
                 (flaky path; exercises utils/retry.py, carries no
-                data damage).
+                data damage),
+- torn_write  — the shard's NEXT write persists only a prefix (torn
+                write-back of a crashing OSD; no damage until the
+                recovery path writes — the intent journal's payload
+                CRC must catch it).
+
+The orchestrator-level adversaries (CrashPoint, MapChurn) live in
+chaos/adversaries.py — they act on pipeline stages and the OSDMap,
+not on a ShardStore's bytes.
 """
 
 from __future__ import annotations
@@ -45,8 +53,10 @@ class Fault:
 
     @property
     def damages_data(self) -> bool:
-        """True when the stored bytes changed (transient faults don't)."""
-        return self.kind != "transient"
+        """True when the stored bytes changed at apply() time
+        (transient faults never do; torn-write arms only damage the
+        FUTURE write they truncate)."""
+        return self.kind not in ("transient", "torn_write")
 
 
 class Injector:
@@ -183,6 +193,49 @@ class TransientErrors(Injector):
             store.arm_transient(s, self.count)
             out.append(Fault("transient", s,
                              detail=f"{self.count} flaky reads"))
+        return out
+
+
+@dataclass
+class TornWrite(Injector):
+    """Arm a prefix-only NEXT write on ``n`` shards (or the pinned
+    ``shards``): the write-back half of the torn-write fault model.
+    ``keep`` bytes survive (when None, a random cut point is drawn per
+    shard against the shard's CURRENT length — or chunk_size when the
+    shard is absent, the recovery-write case this exists for)."""
+
+    shards: Optional[Sequence[int]] = None
+    n: int = 1
+    keep: Optional[int] = None
+    kind = "torn_write"
+
+    def _candidates(self, store: ShardStore) -> List[int]:
+        # unlike the data-damage injectors, torn writes target shards
+        # the RECOVERY path will write — absent shards are the usual
+        # victims, so draw from the full 0..max-shard range the store
+        # has ever seen plus live ids
+        pool = set(store.shard_ids()) | set(store.transient)
+        return sorted(pool)
+
+    def apply(self, store, rng):
+        if self.shards is not None:
+            victims = [int(s) for s in self.shards]
+        else:
+            pool = self._candidates(store)
+            nn = min(self.n, len(pool))
+            victims = [int(s) for s in
+                       rng.choice(pool, size=nn, replace=False)]
+        out = []
+        for s in victims:
+            if self.keep is not None:
+                keep = int(self.keep)
+            else:
+                cur = len(store.shards[s]) if s in store.shards else \
+                    (store.chunk_size or 1)
+                keep = int(rng.integers(0, max(cur, 1)))
+            store.arm_torn_write(s, keep)
+            out.append(Fault("torn_write", s, offset=keep,
+                             detail=f"next write keeps {keep} bytes"))
         return out
 
 

@@ -30,8 +30,14 @@ class ShardStore:
         self.chunk_size = chunk_size
         # shard -> remaining transient read errors before success
         self.transient: Dict[int, int] = {}
+        # shard -> keep-bytes for the NEXT write (TornWrite injector:
+        # the prefix-only write-back of a crashing/partitioned OSD;
+        # consumed by the first write to that shard)
+        self.torn: Dict[int, int] = {}
         self.reads = 0
+        self.writes = 0
         self.transient_failures = 0
+        self.torn_writes = 0
 
     # -- I/O -------------------------------------------------------------
 
@@ -52,6 +58,11 @@ class ShardStore:
         return bytes(self.shards[shard])
 
     def write(self, shard: int, data: bytes) -> None:
+        self.writes += 1
+        keep = self.torn.pop(int(shard), None)
+        if keep is not None:
+            self.torn_writes += 1
+            data = data[:max(0, keep)]
         self.shards[int(shard)] = bytearray(data)
 
     def delete(self, shard: int) -> None:
@@ -60,6 +71,14 @@ class ShardStore:
     def arm_transient(self, shard: int, count: int) -> None:
         """Queue ``count`` transient read failures for ``shard``."""
         self.transient[shard] = self.transient.get(shard, 0) + count
+
+    def arm_torn_write(self, shard: int, keep: int) -> None:
+        """The NEXT write to ``shard`` persists only its first ``keep``
+        bytes — the torn-write fault the intent journal's payload CRC
+        exists to catch (a store-recomputed CRC over the prefix would
+        pass by construction; the journal's is over the full intended
+        payload, so a prefix can never pass)."""
+        self.torn[int(shard)] = int(keep)
 
     def snapshot(self) -> Dict[int, bytes]:
         return {s: bytes(b) for s, b in self.shards.items()}

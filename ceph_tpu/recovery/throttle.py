@@ -1,0 +1,49 @@
+"""Per-OSD recovery throttle — bounded in-flight repair writes.
+
+Reference: osd_recovery_max_active / the AsyncReserver recovery
+reservations (src/common/AsyncReserver.h, PeeringState's
+RemoteRecoveryReservation machinery): a recovering cluster must not
+let repair traffic starve client I/O on any one device, so each OSD
+admits a bounded number of concurrent recovery ops and the rest wait
+their turn.  Here the orchestrator dispatches in rounds; the throttle
+is the per-round admission control: an op is admitted only when EVERY
+target OSD it writes to has a free slot, otherwise it defers to the
+next round (counted — the report proves the bound held).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable
+
+
+@dataclass
+class OsdRecoveryThrottle:
+    """Admit at most ``max_inflight`` recovery write-ops per OSD per
+    round.  ``admit(targets)`` reserves a slot on every target OSD or
+    none (all-or-nothing, so a wide op cannot starve by partially
+    reserving); ``reset_round()`` opens the next round."""
+
+    max_inflight: int = 4
+    inflight: Dict[int, int] = field(default_factory=dict)
+    deferrals: int = 0        # lifetime count of refused admissions
+    admitted: int = 0         # lifetime count of granted admissions
+    peak: int = 0             # max per-osd admissions ever observed
+
+    def admit(self, targets: Iterable[int]) -> bool:
+        osds = [int(o) for o in targets]
+        if any(self.inflight.get(o, 0) >= self.max_inflight
+               for o in osds):
+            self.deferrals += 1
+            return False
+        for o in osds:
+            self.inflight[o] = self.inflight.get(o, 0) + 1
+            self.peak = max(self.peak, self.inflight[o])
+        self.admitted += 1
+        return True
+
+    def reset_round(self) -> None:
+        self.inflight.clear()
+
+
+__all__ = ["OsdRecoveryThrottle"]

@@ -117,12 +117,17 @@ class RepairReport:
 class BatchRepairReport:
     """repair_batched's outcome across many objects: per-object
     RepairReports plus the device-traffic accounting the batching
-    exists for (one fused dispatch per erasure-pattern batch)."""
+    exists for (one fused dispatch per erasure-pattern batch) and the
+    epoch-fencing accounting (how often the OSDMap moved between plan
+    and dispatch, forcing a re-scrub + re-group)."""
 
     reports: List[RepairReport] = field(default_factory=list)
     pattern_batches: int = 0     # distinct (reads, erased, len) groups
     device_calls: int = 0        # fused decode+re-encode dispatches
     host_batches: int = 0        # groups served by the numpy tier
+    regroups: int = 0            # stale-epoch re-plans before dispatch
+    plan_epoch: Optional[int] = None   # map epoch the live grouping is
+                                       # keyed to (None: no osdmap given)
 
     @property
     def repaired_objects(self) -> List[int]:
@@ -335,7 +340,9 @@ def repair(sinfo: StripeInfo, ec, store, hinfo: HashInfo,
 def repair_batched(sinfo: StripeInfo, ec, stores, hinfos, *,
                    retry_policy: Optional[RetryPolicy] = None,
                    clock=None, write_back: bool = True,
-                   device: Optional[bool] = None) -> BatchRepairReport:
+                   device: Optional[bool] = None,
+                   osdmap=None,
+                   on_batch=None) -> BatchRepairReport:
     """Repair MANY same-geometry objects with one fused device call
     per erasure-pattern batch.
 
@@ -361,6 +368,16 @@ def repair_batched(sinfo: StripeInfo, ec, stores, hinfos, *,
     unless the fallback policy sits on the numpy tier; False forces
     the grouped HOST path (same grouping, zero jax dispatches — the
     bench's tunnel-down error path must never touch a wedged device).
+
+    ``osdmap``: when given, the grouping is epoch-fenced — the plan is
+    stamped with the map's epoch, and before every pattern-batch
+    dispatch the CURRENT epoch is re-checked (crush/incremental.py);
+    on a stale epoch the not-yet-dispatched objects are re-scrubbed
+    and re-grouped against the world as it now is instead of
+    dispatching the stale grouping (counted in ``regroups``).
+    ``on_batch(batch_index, key)`` fires before each dispatch — the
+    documented interleave point where MapChurn / CrashPoint
+    adversaries (and the recovery orchestrator's stage hooks) run.
     """
     stores = [ensure_store(s, chunk_size=sinfo.chunk_size)
               for s in stores]
@@ -370,54 +387,88 @@ def repair_batched(sinfo: StripeInfo, ec, stores, hinfos, *,
                          f"HashInfos")
     from ..codes.engine import fused_repair_call
     from ..codes.techniques import _numpy_tier
+    from ..crush.incremental import get_epoch
     from ..utils.perf import global_perf
     n = ec.get_chunk_count()
     k = ec.get_data_chunk_count()
     mapping = stripe_mod._chunk_mapping(ec)
     reports: List[Optional[RepairReport]] = [None] * len(stores)
-    groups: Dict[tuple, List[int]] = {}
-    scrubs: List[ScrubReport] = []
-    for i, (store, hinfo) in enumerate(zip(stores, hinfos)):
-        rep = deep_scrub(sinfo, ec, store, hinfo,
-                         retry_policy=retry_policy, clock=clock)
-        scrubs.append(rep)
-        if rep.is_clean:
-            reports[i] = RepairReport(scrub=rep, reencode_verified=True,
-                                      crc_verified=True)
-            continue
-        n_stripes = rep.shard_length // sinfo.chunk_size
+    scrubs: List[Optional[ScrubReport]] = [None] * len(stores)
 
-        def _unrecoverable(cause=None, rep=rep, n_stripes=n_stripes):
-            return UnrecoverableError(
-                f"object {i}: {len(rep.bad)} shards lost/corrupt exceed "
-                f"the failure budget of this "
-                f"{ec.get_data_chunk_count()}+"
-                f"{ec.get_coding_chunk_count()} code",
-                shards=rep.bad,
-                extents=unrecoverable_extents(sinfo, ec, rep.bad,
-                                              n_stripes),
-                cause=cause)
+    def _plan(indices) -> Dict[tuple, List[int]]:
+        """Scrub + classify + feasibility-check ``indices``; returns
+        the (clean, erased, length) pattern grouping.  Re-run whole
+        whenever the map epoch moves between plan and dispatch."""
+        groups: Dict[tuple, List[int]] = {}
+        for i in indices:
+            rep = deep_scrub(sinfo, ec, stores[i], hinfos[i],
+                             retry_policy=retry_policy, clock=clock)
+            scrubs[i] = rep
+            if rep.is_clean:
+                reports[i] = RepairReport(scrub=rep,
+                                          reencode_verified=True,
+                                          crc_verified=True)
+                continue
+            n_stripes = rep.shard_length // sinfo.chunk_size
 
-        if len(rep.clean) < k:
-            raise _unrecoverable()
-        try:
-            # feasibility oracle only — the fused call stacks EVERY
-            # clean shard, because the re-encode half needs all k data
-            # chunks (lrc's minimum plan can skip clean data shards
-            # outside the local group) and the host gates read every
-            # shard regardless; decode output is byte-identical at any
-            # valid availability
-            ec.minimum_to_decode(set(rep.bad), set(rep.clean))
-        except (IOError, ValueError) as e:
-            raise _unrecoverable(cause=e) from e
-        key = (tuple(rep.clean), tuple(rep.bad), rep.shard_length)
-        groups.setdefault(key, []).append(i)
+            def _unrecoverable(cause=None, i=i, rep=rep,
+                               n_stripes=n_stripes):
+                return UnrecoverableError(
+                    f"object {i}: {len(rep.bad)} shards lost/corrupt "
+                    f"exceed the failure budget of this "
+                    f"{ec.get_data_chunk_count()}+"
+                    f"{ec.get_coding_chunk_count()} code",
+                    shards=rep.bad,
+                    extents=unrecoverable_extents(sinfo, ec, rep.bad,
+                                                  n_stripes),
+                    cause=cause)
 
+            if len(rep.clean) < k:
+                raise _unrecoverable()
+            try:
+                # feasibility oracle only — the fused call stacks
+                # EVERY clean shard, because the re-encode half needs
+                # all k data chunks (lrc's minimum plan can skip clean
+                # data shards outside the local group) and the host
+                # gates read every shard regardless; decode output is
+                # byte-identical at any valid availability
+                ec.minimum_to_decode(set(rep.bad), set(rep.clean))
+            except (IOError, ValueError) as e:
+                raise _unrecoverable(cause=e) from e
+            key = (tuple(rep.clean), tuple(rep.bad), rep.shard_length)
+            groups.setdefault(key, []).append(i)
+        return groups
+
+    plan_epoch = get_epoch(osdmap) if osdmap is not None else None
+    pending = list(_plan(range(len(stores))).items())
     perf = global_perf()
     device_calls = 0
     host_batches = 0
+    pattern_batches = 0
+    regroups = 0
+    batch_index = 0
     gate_failures: List[str] = []
-    for (available, erased, shard_len), members in groups.items():
+    call_hook = True
+    while pending:
+        (available, erased, shard_len), members = pending[0]
+        if call_hook and on_batch is not None:
+            on_batch(batch_index, (available, erased, shard_len))
+        call_hook = True
+        batch_index += 1
+        if osdmap is not None and get_epoch(osdmap) != plan_epoch:
+            # the map moved between plan and this dispatch: the stale
+            # grouping must not be dispatched — re-scrub everything
+            # still pending and re-group against the current epoch
+            # (the hook is NOT re-fired for the regrouped head, so one
+            # churn event costs at most one regroup, never a livelock)
+            remaining = sorted({i for _, ms in pending for i in ms})
+            plan_epoch = get_epoch(osdmap)
+            regroups += 1
+            pending = list(_plan(remaining).items())
+            call_hook = False
+            continue
+        pending.pop(0)
+        pattern_batches += 1
         n_stripes = shard_len // sinfo.chunk_size
         reads_by_obj: List[Dict[int, bytes]] = []
         stacks = []
@@ -505,14 +556,17 @@ def repair_batched(sinfo: StripeInfo, ec, stores, hinfos, *,
             reports[i] = RepairReport(scrub=scrubs[i], repaired=rec,
                                       reencode_verified=True,
                                       crc_verified=True)
-    if groups:
+    if pattern_batches:
         dout("ec", 5, f"repair_batched: {len(stores)} objects, "
-                      f"{len(groups)} pattern batches, "
-                      f"{device_calls} device calls")
+                      f"{pattern_batches} pattern batches, "
+                      f"{device_calls} device calls, "
+                      f"{regroups} stale-epoch regroups")
     out = BatchRepairReport(reports=reports,  # type: ignore[arg-type]
-                            pattern_batches=len(groups),
+                            pattern_batches=pattern_batches,
                             device_calls=device_calls,
-                            host_batches=host_batches)
+                            host_batches=host_batches,
+                            regroups=regroups,
+                            plan_epoch=plan_epoch)
     if gate_failures:
         raise ScrubError(
             "batched repair verification failed — refusing to write "

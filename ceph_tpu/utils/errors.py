@@ -31,18 +31,41 @@ class TransientBackendError(CephTpuError):
 
 
 class RetryExhausted(CephTpuError):
-    """retry_call gave up: every attempt raised a retryable error.
+    """retry_call gave up: every attempt raised a retryable error, or
+    the policy's overall deadline expired mid-schedule.
 
     The last underlying error is chained as ``__cause__`` and kept as
-    ``.last``; ``.attempts`` records how many tries ran.
+    ``.last``; ``.attempts`` records how many tries ran, ``.elapsed``
+    the wall (or FakeClock) seconds the whole schedule consumed, and
+    ``.deadline_expired`` whether the budget that ran out was time
+    rather than attempts.
     """
 
-    def __init__(self, attempts: int, last: BaseException) -> None:
-        super().__init__(
-            f"retry exhausted after {attempts} attempts: "
-            f"{type(last).__name__}: {last}")
+    def __init__(self, attempts: int, last: BaseException,
+                 elapsed: Optional[float] = None,
+                 deadline_expired: bool = False) -> None:
+        msg = f"retry exhausted after {attempts} attempts"
+        if elapsed is not None:
+            msg += f" in {elapsed:.3f}s"
+        if deadline_expired:
+            msg += " (deadline expired)"
+        super().__init__(f"{msg}: {type(last).__name__}: {last}")
         self.attempts = attempts
         self.last = last
+        self.elapsed = elapsed
+        self.deadline_expired = deadline_expired
+
+
+class InjectedCrash(CephTpuError):
+    """A deterministic crash raised at a named crash site
+    (chaos.CrashPoint) — the process-died stand-in the recovery
+    orchestrator's journal replay must survive.  ``.site`` is the
+    crash-site name, ``.hit`` which visit fired."""
+
+    def __init__(self, site: str, hit: int = 1) -> None:
+        super().__init__(f"injected crash at site {site!r} (hit {hit})")
+        self.site = site
+        self.hit = hit
 
 
 class ScrubError(CephTpuError):

@@ -7,7 +7,17 @@ framework's needs: ``retry_call`` runs a callable, retries only the
 exception types the policy names (default: TransientBackendError),
 sleeps an exponentially growing, capped delay between attempts, and
 raises RetryExhausted — with the last error chained — when the budget
-is spent.
+is spent.  Two budgets exist: ``attempts`` (tries) and ``deadline``
+(overall elapsed seconds; the recovery orchestrator's
+deadline-carrying retries ride this — an op must never keep retrying
+past the time its recovery reservation is worth).
+
+Backoff can carry decorrelated jitter (``jitter="decorrelated"``, the
+AWS-architecture-blog schedule: delay ~ U(base, prev*3) capped at
+max_delay) so a fleet of throttled recovery ops retrying the same
+flaky OSD doesn't thundering-herd on synchronized exponential steps.
+The jitter rng is injectable just like the clock, so tests assert
+exact schedules.
 
 The clock is injectable: tests pass ``FakeClock`` and assert the exact
 backoff schedule with ZERO real sleeping (the no-real-sleeps rule for
@@ -17,6 +27,7 @@ the chaos/scrub suites); production uses the module default
 
 from __future__ import annotations
 
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple, Type
@@ -54,12 +65,21 @@ class FakeClock:
 @dataclass(frozen=True)
 class RetryPolicy:
     """attempts total tries; delay(i) = min(base * multiplier^i, max)
-    after failed attempt i (no delay after the final failure)."""
+    after failed attempt i (no delay after the final failure).
+
+    ``deadline``: overall elapsed budget in seconds — the schedule
+    stops (RetryExhausted, deadline_expired=True) once the deadline
+    passes or the next backoff sleep would overrun it, regardless of
+    attempts remaining.  ``jitter="decorrelated"`` replaces the pure
+    exponential with delay ~ U(base_delay, prev_delay * 3) capped at
+    max_delay (rng injectable through retry_call)."""
 
     attempts: int = 3
     base_delay: float = 0.01
     multiplier: float = 2.0
     max_delay: float = 1.0
+    deadline: Optional[float] = None
+    jitter: str = "none"            # "none" | "decorrelated"
     retry_on: Tuple[Type[BaseException], ...] = (TransientBackendError,)
 
     def __post_init__(self) -> None:
@@ -67,10 +87,26 @@ class RetryPolicy:
             raise ValueError(f"attempts={self.attempts} must be >= 1")
         if self.base_delay < 0 or self.max_delay < 0:
             raise ValueError("delays must be >= 0")
+        if self.deadline is not None and self.deadline <= 0:
+            raise ValueError(f"deadline={self.deadline} must be > 0")
+        if self.jitter not in ("none", "decorrelated"):
+            raise ValueError(f"jitter={self.jitter!r} must be 'none' or "
+                             f"'decorrelated'")
 
-    def delay(self, failed_attempt: int) -> float:
-        return min(self.base_delay * self.multiplier ** failed_attempt,
+    def delay(self, failed_attempt: int,
+              prev_delay: Optional[float] = None,
+              rng: Optional[random.Random] = None) -> float:
+        base = min(self.base_delay * self.multiplier ** failed_attempt,
                    self.max_delay)
+        if self.jitter == "none":
+            return base
+        # decorrelated jitter: sleep ~ U(base_delay, prev * 3), capped.
+        # The first backoff seeds the walk with the plain base delay.
+        prev = base if prev_delay is None else prev_delay
+        rng = rng or random.Random()
+        hi = max(self.base_delay, prev * 3.0)
+        return min(self.max_delay,
+                   rng.uniform(min(self.base_delay, hi), hi))
 
 
 @dataclass
@@ -87,31 +123,51 @@ def retry_call(fn: Callable, *args,
                clock=None,
                on_retry: Optional[Callable] = None,
                stats: Optional[RetryStats] = None,
+               rng: Optional[random.Random] = None,
                **kwargs):
     """Run ``fn(*args, **kwargs)`` under ``policy``.
 
     Retries only ``policy.retry_on`` exceptions; anything else
     propagates on the first raise (a corrupt shard is not a flaky
     read).  ``on_retry(attempt_index, delay, error)`` fires before
-    each backoff sleep.  Raises RetryExhausted(attempts, last) when
-    every attempt failed.
+    each backoff sleep.  Raises RetryExhausted(attempts, last,
+    elapsed) when every attempt failed or when ``policy.deadline``
+    elapsed seconds have been spent (deadline_expired=True) — a
+    deadline stop never sleeps first, so the caller gets the time
+    back.  ``rng`` seeds the decorrelated-jitter draw when the policy
+    asks for it.
     """
     policy = policy or RetryPolicy()
     clock = clock or SystemClock()
+    start = clock.monotonic()
     last: Optional[BaseException] = None
+    prev_delay: Optional[float] = None
+    attempts_made = 0
+    deadline_expired = False
     for attempt in range(policy.attempts):
+        attempts_made = attempt + 1
         if stats is not None:
-            stats.attempts = attempt + 1
+            stats.attempts = attempts_made
         try:
             return fn(*args, **kwargs)
         except policy.retry_on as e:
             last = e
             if attempt + 1 >= policy.attempts:
                 break
-            d = policy.delay(attempt)
+            d = policy.delay(attempt, prev_delay=prev_delay, rng=rng)
+            prev_delay = d
+            if policy.deadline is not None:
+                elapsed = clock.monotonic() - start
+                if elapsed + d > policy.deadline:
+                    # the next sleep would overrun the deadline: stop
+                    # NOW rather than sleeping into certain failure
+                    deadline_expired = True
+                    break
             if stats is not None:
                 stats.delays.append(d)
             if on_retry is not None:
                 on_retry(attempt, d, e)
             clock.sleep(d)
-    raise RetryExhausted(policy.attempts, last) from last
+    elapsed = clock.monotonic() - start
+    raise RetryExhausted(attempts_made, last, elapsed=elapsed,
+                         deadline_expired=deadline_expired) from last

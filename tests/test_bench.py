@@ -176,12 +176,13 @@ def test_degraded_workload_rejects_over_budget_args():
 def test_bench_degraded_rows_config():
     """bench.py's recovery rows stay within the failure budget and
     cover 0 / 1 / m-combined fault levels plus the batched repair
-    row (ISSUE 3: the scrub batching is measured every round)."""
+    row (ISSUE 3) and the churn-fenced recovery row (ISSUE 4)."""
     import bench
     names = [n for n, _ in bench.DEGRADED_ROWS]
     assert names == ["rs_k8_m3_scrub_e0", "rs_k8_m3_degraded_e1",
                      "rs_k8_m3_degraded_e2_c1",
-                     "rs_k8_m3_repair_batched_e1"]
+                     "rs_k8_m3_repair_batched_e1",
+                     "rs_k8_m3_recovery_churn"]
     workloads = set()
     for _, extra in bench.DEGRADED_ROWS:
         args = bench.DEGRADED_COMMON + ["--iterations", "1"] + extra
@@ -190,7 +191,36 @@ def test_bench_degraded_rows_config():
         workloads.add(b.args.workload)
         e = b.args.erasures + b.args.corruptions
         assert e <= 3                  # m=3 budget
-    assert workloads == {"degraded", "repair-batched"}
+    assert workloads == {"degraded", "repair-batched",
+                         "recovery-churn"}
+
+
+def test_recovery_churn_workload():
+    """--workload recovery-churn: the orchestrator heals --batch
+    objects to byte-identical convergence while MapChurn advances the
+    map every --churn-every dispatches; the row proves the fencing
+    ran (epochs advanced, replans/regroups counted) and the batching
+    held on the host path (zero device calls)."""
+    res = run_bench(["--plugin", "jerasure",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--batch", "4",
+                     "--iterations", "1",
+                     "--workload", "recovery-churn", "--erasures", "1",
+                     "--churn-every", "2", "--device", "host"])
+    assert res["workload"] == "recovery-churn"
+    assert res["gbps"] > 0
+    assert res["epochs_advanced"] >= 1
+    assert res["replans"] + res["regroups"] >= 1
+    assert res["device_calls"] == 0        # --device host
+    assert res["pattern_batches"] >= 1
+
+
+def test_recovery_churn_workload_rejects_zero_erasures():
+    with pytest.raises(ValueError, match="erasures"):
+        run_bench(["--plugin", "jerasure",
+                   "--parameter", "k=2", "--parameter", "m=1",
+                   "--size", "4096", "--workload", "recovery-churn",
+                   "--erasures", "0", "--device", "host"])
 
 
 def test_repair_batched_workload():

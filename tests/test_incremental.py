@@ -346,3 +346,47 @@ def test_incremental_wire_crush_payload():
     for ps in range(32):
         assert (m1.pg_to_up_acting_osds(1, ps)
                 == m2.pg_to_up_acting_osds(1, ps))
+
+
+# -- churn-sequence property (ISSUE 4 satellite) -------------------------
+
+@pytest.mark.parametrize("seed", range(8))
+def test_churn_sequence_incrementally_equals_rebuilt_final_map(seed):
+    """Property: a seeded MapChurn sequence applied incrementally is
+    placement-identical to a map REBUILT directly at the final epoch
+    (same crush tree, the churn's net osd up/out/weight state applied
+    as direct edits) — epoch-by-epoch catch-up and full rebuild are
+    the same map, which is what the recovery orchestrator's replan-
+    against-current-epoch discipline leans on."""
+    from ceph_tpu.chaos import MapChurn
+
+    m_inc = make_map(pg_num=48)
+    churn = MapChurn(seed=seed, max_down=2, p_fire=0.8, max_events=12)
+    for i in range(30):
+        churn.step(m_inc, stage=("plan", "dispatch",
+                                 "writeback")[i % 3])
+    assert get_epoch(m_inc) == churn.epochs_advanced
+
+    # rebuild: a fresh map with the same crush tree, fast-forwarded to
+    # the net final state by direct edits (weights carry the out/in
+    # truth; up follows the surviving down set)
+    m_dir = make_map(pg_num=48)
+    for osd in range(m_inc.max_osd):
+        m_dir.osd_weight[osd] = m_inc.osd_weight[osd]
+        m_dir.osd_up[osd] = m_inc.osd_up[osd]
+        m_dir.osd_exists[osd] = m_inc.osd_exists[osd]
+
+    for ps in range(m_dir.pools[1].pg_num):
+        assert (m_inc.pg_to_up_acting_osds(1, ps)
+                == m_dir.pg_to_up_acting_osds(1, ps)), (seed, ps)
+    up_i, pr_i = m_inc.pg_to_up_bulk(1, engine="host")
+    up_d, pr_d = m_dir.pg_to_up_bulk(1, engine="host")
+    assert np.array_equal(up_i, up_d) and np.array_equal(pr_i, pr_d)
+
+    # and replaying the SAME recorded incrementals onto a third fresh
+    # map via catch_up lands on the identical placement too
+    m_replay = make_map(pg_num=48)
+    assert catch_up(m_replay, churn.incrementals) == get_epoch(m_inc)
+    for ps in range(0, m_dir.pools[1].pg_num, 5):
+        assert (m_replay.pg_to_up_acting_osds(1, ps)
+                == m_inc.pg_to_up_acting_osds(1, ps)), (seed, ps)

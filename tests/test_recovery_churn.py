@@ -1,0 +1,388 @@
+"""Recovery orchestrator under OSDMap churn, crashes and torn writes
+(ISSUE 4): epoch-stamped ops re-plan instead of writing to down/out
+devices, the write-ahead intent journal makes every crash site
+resumable and idempotent, and the seeded torture sweep proves
+zero-data-loss convergence across MapChurn x CrashPoint x TornWrite x
+shard faults.  The tier-1 slice here stays host-path (device=False)
+and FakeClock-driven — no jax dispatch, no real sleeps; the >=200-case
+sweep is @slow (tools/test_full.sh runs it)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.chaos import (
+    CRASH_SITES,
+    BitFlip,
+    CrashPoint,
+    MapChurn,
+    ShardErasure,
+    TornWrite,
+    inject,
+)
+from ceph_tpu.chaos.store import ShardStore
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+from ceph_tpu.codes.stripe import HashInfo, StripeInfo, encode
+from ceph_tpu.crush import (
+    CrushBuilder,
+    step_chooseleaf_indep,
+    step_emit,
+    step_take,
+)
+from ceph_tpu.crush.incremental import (
+    CEPH_OSD_UP,
+    Incremental,
+    apply_incremental,
+    get_epoch,
+)
+from ceph_tpu.crush.osdmap import OSDMap, PGPool
+from ceph_tpu.recovery import (
+    IntentJournal,
+    OsdRecoveryThrottle,
+    RecoveryOrchestrator,
+    healed,
+    payload_digest,
+    recover_to_completion,
+)
+from ceph_tpu.utils.errors import InjectedCrash
+from ceph_tpu.utils.retry import FakeClock, RetryPolicy
+
+K, M = 4, 2
+N = K + M
+POOL, PS = 1, 9
+
+
+def build_cluster(n_hosts=N + 3, devs=2, size=N):
+    b = CrushBuilder()
+    root = b.build_two_level(n_hosts, devs)
+    b.add_rule(0, [step_take(root),
+                   step_chooseleaf_indep(size, b.type_id("host")),
+                   step_emit()])
+    osdmap = OSDMap(crush=b.map)
+    osdmap.pools[POOL] = PGPool(pool_id=POOL, pg_num=16, size=size,
+                                erasure=True)
+    return osdmap
+
+
+def make_pg(n_objects=3, stripes=2, size=1024, seed=7, faults=None):
+    """(sinfo, ec, osdmap, originals, stores, hinfos): an encoded pg
+    with per-object (erased, flipped) fault lists applied."""
+    reg = ErasureCodePluginRegistry.instance()
+    ec = reg.factory("jerasure", {"technique": "reed_sol_van",
+                                  "k": str(K), "m": str(M)})
+    width = K * ec.get_chunk_size(K * size)
+    sinfo = StripeInfo(K, width)
+    osdmap = build_cluster()
+    rng = np.random.default_rng(seed)
+    faults = faults or [([0], []), ([3], [1]), ([], [4])][:n_objects]
+    originals, stores, hinfos = [], [], []
+    for i in range(n_objects):
+        obj = rng.integers(0, 256, size=width * stripes,
+                           dtype=np.uint8).tobytes()
+        shards = encode(sinfo, ec, obj)
+        hinfo = HashInfo(N)
+        hinfo.append(0, shards)
+        erased, flipped = faults[i % len(faults)]
+        inj = []
+        if erased:
+            inj.append(ShardErasure(shards=list(erased)))
+        if flipped:
+            inj.append(BitFlip(shards=list(flipped), flips=1))
+        store, _ = inject(shards, inj, seed=seed + i,
+                          chunk_size=sinfo.chunk_size)
+        originals.append(shards)
+        stores.append(store)
+        hinfos.append(hinfo)
+    return sinfo, ec, osdmap, originals, stores, hinfos
+
+
+def recover(sinfo, ec, osdmap, stores, hinfos, **kw):
+    kw.setdefault("device", False)
+    kw.setdefault("clock", FakeClock())
+    return recover_to_completion(sinfo, ec, osdmap, POOL, PS,
+                                 stores, hinfos, **kw)
+
+
+# -- convergence + idempotency ---------------------------------------------
+
+def test_recovery_converges_byte_identical():
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg()
+    rep = recover(sinfo, ec, osdmap, stores, hinfos)
+    assert rep.converged and not rep.unrecoverable
+    assert rep.ops_completed == 3          # every object carried damage
+    assert healed(stores, originals)
+    assert len(rep.writes) >= 3
+
+
+def test_rerun_is_noop():
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg()
+    recover(sinfo, ec, osdmap, stores, hinfos)
+    rep2 = recover(sinfo, ec, osdmap, stores, hinfos)
+    assert rep2.converged and rep2.ops_planned == 0
+    assert not rep2.writes and rep2.rounds == 0
+    assert healed(stores, originals)
+
+
+# -- the epoch fence (acceptance criterion) --------------------------------
+
+class OutBetweenDecodeAndWriteback:
+    """Churn stand-in that marks one acting OSD down+out the FIRST
+    time the orchestrator reaches the write-back stage — i.e. between
+    decode and write-back, the exact window the fence must cover."""
+
+    def __init__(self, slot):
+        self.slot = slot
+        self.victim = None
+
+    def step(self, osdmap, stage):
+        if stage != "writeback" or self.victim is not None:
+            return
+        _, _, acting, _ = osdmap.pg_to_up_acting_osds(POOL, PS)
+        self.victim = int(acting[self.slot])
+        apply_incremental(osdmap, Incremental(
+            epoch=get_epoch(osdmap) + 1,
+            new_state={self.victim: CEPH_OSD_UP},
+            new_weight={self.victim: 0}))
+
+
+def test_epoch_fence_replans_to_new_placement():
+    # erase shard 0 of every object; its write-back target is acting
+    # slot 0 — which goes down+out between decode and write-back
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        faults=[([0], [])])
+    churn = OutBetweenDecodeAndWriteback(slot=0)
+    rep = recover(sinfo, ec, osdmap, stores, hinfos, churn=churn)
+    assert rep.converged and healed(stores, originals)
+    # the re-plan is visible in the report counters...
+    assert rep.replans >= 1
+    # ...and no write EVER landed on the downed device after its epoch
+    down_epoch = get_epoch(osdmap)
+    assert churn.victim is not None
+    for w in rep.writes:
+        if w.osd == churn.victim:
+            assert w.epoch < down_epoch
+        assert w.osd != churn.victim or not (
+            not osdmap.is_up(w.osd) and w.epoch >= down_epoch)
+    late = [w for w in rep.writes if w.epoch >= down_epoch]
+    assert late, "fence test never exercised the post-churn epoch"
+    assert all(w.osd != churn.victim for w in late)
+
+
+def test_regroup_on_dispatch_churn():
+    """repair_batched's own fence: the map moving between plan and a
+    pattern-batch dispatch forces a re-scrub + regroup (never a stale
+    dispatch), counted in the batch report and the recovery report."""
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        n_objects=4, faults=[([0], []), ([3], []), ([0], []), ([3], [])])
+    churn = MapChurn(seed=3, max_events=1, fire_every=1,
+                     stages=("dispatch",))
+    rep = recover(sinfo, ec, osdmap, stores, hinfos, churn=churn)
+    assert churn.epochs_advanced == 1
+    assert rep.regroups >= 1
+    assert rep.converged and healed(stores, originals)
+
+
+# -- crash sites + journal replay ------------------------------------------
+
+@pytest.mark.parametrize("site", CRASH_SITES)
+def test_crash_at_every_site_resumes_idempotently(site):
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg()
+    journal = IntentJournal()
+    rep = recover(sinfo, ec, osdmap, stores, hinfos, journal=journal,
+                  crashpoint=CrashPoint(site=site))
+    assert rep.crashes == 1
+    assert rep.converged and not rep.unrecoverable
+    assert healed(stores, originals)
+    assert not journal.pending()           # nothing left in flight
+    # a fresh run over the healed pg is a no-op (idempotency)
+    rep2 = recover(sinfo, ec, osdmap, stores, hinfos, journal=journal)
+    assert rep2.ops_planned == 0 and not rep2.writes
+    assert healed(stores, originals)
+
+
+def test_crash_after_commit_replay_keeps_writes():
+    """Crash AFTER commit but before clear: replay must verify and
+    keep the landed shards (completed), never roll them back."""
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        n_objects=1, faults=[([2], [])])
+    rep = recover(sinfo, ec, osdmap, stores, hinfos,
+                  crashpoint=CrashPoint(site="writeback.after_commit"))
+    assert rep.crashes == 1 and healed(stores, originals)
+    assert rep.journal.completed >= 1
+    assert rep.journal.shards_deleted == 0
+
+
+def test_torn_write_caught_live_and_rewritten():
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        n_objects=1, faults=[([1], [])])
+    TornWrite(shards=[1], keep=5).apply(stores[0],
+                                        np.random.default_rng(0))
+    rep = recover(sinfo, ec, osdmap, stores, hinfos)
+    assert rep.torn_rewrites >= 1
+    assert rep.converged and healed(stores, originals)
+
+
+def test_torn_write_under_crash_rolled_back_by_replay():
+    """Crash mid-write-back with a torn write armed: the journal's
+    full-payload CRC catches the prefix at replay (a store-side CRC
+    would bless it) and rolls it back; recovery then re-repairs."""
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        n_objects=1, faults=[([1], [])])
+    TornWrite(shards=[1], keep=7).apply(stores[0],
+                                        np.random.default_rng(0))
+    rep = recover(sinfo, ec, osdmap, stores, hinfos,
+                  crashpoint=CrashPoint(site="writeback.after_write"))
+    assert rep.crashes == 1
+    assert rep.journal.shards_deleted >= 1
+    assert rep.journal.rolled_back >= 1
+    assert rep.converged and healed(stores, originals)
+
+
+def test_journal_replay_is_idempotent():
+    store = ShardStore({0: b"full-payload", 1: b"torn"},
+                       chunk_size=16)
+    j = IntentJournal()
+    j.begin(j.allocate_op_id(), 0, 5,
+            {0: b"full-payload", 1: b"torn-but-intended-longer"},
+            {0: 10, 1: 11})
+    s1 = j.replay([store])
+    assert s1.replayed == 1 and s1.rolled_back == 1
+    assert s1.shards_kept == 1 and s1.shards_deleted == 1
+    assert store.shards.get(0) == bytearray(b"full-payload")
+    assert 1 not in store.shards
+    snap = store.snapshot()
+    s2 = j.replay([store])                  # second replay: no-op
+    assert s2.replayed == 0 and store.snapshot() == snap
+    assert not j.pending()
+
+
+def test_journal_digest_rejects_prefix():
+    full = b"0123456789abcdef"
+    assert payload_digest(full) != payload_digest(full[:8])
+    assert payload_digest(full)[1] == len(full)
+
+
+# -- throttle + deadlines --------------------------------------------------
+
+def test_throttle_bounds_per_osd_admissions():
+    faults = [([0], [])] * 5               # 5 ops, all writing slot 0
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        n_objects=5, faults=faults)
+    throttle = OsdRecoveryThrottle(max_inflight=2)
+    rep = recover(sinfo, ec, osdmap, stores, hinfos, throttle=throttle)
+    assert rep.converged and healed(stores, originals)
+    assert throttle.peak <= 2
+    assert rep.throttle_deferrals >= 1     # 5 ops through 2 slots
+    assert rep.rounds >= 3
+
+
+def test_deadline_expired_op_reported_not_retried():
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        n_objects=2, faults=[([0], [])])
+    clock = FakeClock()
+    # max_inflight=0 admits nothing, so ops can only defer until the
+    # round_delay-driven clock passes their deadline
+    rep = recover(sinfo, ec, osdmap, stores, hinfos, clock=clock,
+                  throttle=OsdRecoveryThrottle(max_inflight=0),
+                  op_deadline=1.5, round_delay=1.0)
+    assert rep.converged
+    assert rep.expired == [0, 1]
+    assert rep.ops_completed == 0 and not rep.writes
+    # no op ever retried past its deadline: once expired, planning
+    # stopped producing it (2 throttle rounds, then expiry)
+    assert rep.rounds <= 3
+
+
+# -- MapChurn determinism --------------------------------------------------
+
+def test_mapchurn_replays_deterministically():
+    evs = []
+    for _ in range(2):
+        osdmap = build_cluster()
+        churn = MapChurn(seed=11, max_down=2, p_fire=1.0, max_events=6)
+        for i in range(10):
+            churn.step(osdmap, "plan" if i % 2 else "writeback")
+        evs.append(churn.events)
+    assert evs[0] == evs[1] and len(evs[0]) == 6
+    assert get_epoch(osdmap) == 6
+
+
+def test_mapchurn_respects_max_down_and_avoid():
+    osdmap = build_cluster()
+    protected = (0, 1, 2)
+    churn = MapChurn(seed=5, max_down=1, p_fire=1.0,
+                     avoid_osds=protected)
+    for _ in range(40):
+        churn.step(osdmap, "plan")
+    assert len(churn.downed) <= 1
+    for ev in churn.events:
+        if ev["kind"] == "down":
+            osd = int(ev["detail"].split(".")[1].split()[0])
+            assert osd not in protected
+
+
+# -- the torture gate (>=200 seeded scenarios) -----------------------------
+
+def _torture_scenarios():
+    """MapChurn x CrashPoint x TornWrite x shard-fault grid: 7 crash
+    options x 2 torn x 15 seeds = 210 scenarios."""
+    sites = (None,) + CRASH_SITES
+    for seed in range(15):
+        for si, site in enumerate(sites):
+            for torn in (False, True):
+                yield seed * 100 + si * 10 + torn, site, torn
+
+
+def _run_scenario(scenario_seed, site, torn):
+    rng = np.random.default_rng(scenario_seed)
+    faults = []
+    for _ in range(3):
+        n_bad = int(rng.integers(1, M + 1))
+        victims = rng.choice(N, size=n_bad, replace=False)
+        cut = int(rng.integers(0, n_bad + 1))
+        faults.append(([int(v) for v in victims[:cut]],
+                       [int(v) for v in victims[cut:]]))
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        n_objects=3, seed=scenario_seed, faults=faults)
+    if torn:
+        erased, _ = faults[0]
+        victim = erased[0] if erased else 0
+        TornWrite(shards=[victim], keep=9).apply(
+            stores[0], np.random.default_rng(scenario_seed))
+    churn = MapChurn(seed=scenario_seed, max_down=1, p_fire=0.4,
+                     max_events=3)
+    crash = CrashPoint(site=site) if site else None
+    journal = IntentJournal()
+    rep = recover(sinfo, ec, osdmap, stores, hinfos, journal=journal,
+                  churn=churn, crashpoint=crash, op_deadline=1e6)
+    # zero data loss: every recoverable object byte-identical
+    ok = [i for i in range(3) if i not in rep.unrecoverable]
+    assert rep.converged, (scenario_seed, site, torn)
+    assert healed([stores[i] for i in ok],
+                  [originals[i] for i in ok]), (scenario_seed, site, torn)
+    assert not journal.pending()
+    assert not rep.expired                  # deadline never overrun
+    if site:
+        assert rep.crashes == 1
+    # idempotency: re-running recovery is a no-op
+    rep2 = recover(sinfo, ec, osdmap, stores, hinfos, journal=journal)
+    assert rep2.ops_planned == len(rep.unrecoverable) * 0
+    assert not rep2.writes
+    assert healed([stores[i] for i in ok], [originals[i] for i in ok])
+
+
+@pytest.mark.parametrize("scenario_seed,site,torn",
+                         list(_torture_scenarios())[:12])
+def test_recovery_torture_smoke(scenario_seed, site, torn):
+    """Tier-1 slice of the torture grid (first 12 scenarios)."""
+    _run_scenario(scenario_seed, site, torn)
+
+
+@pytest.mark.slow
+def test_recovery_torture_full():
+    """The >=200-scenario torture gate (ISSUE 4 acceptance): every
+    seeded MapChurn x CrashPoint x TornWrite x fault mix converges
+    with zero data loss and an idempotent journal."""
+    scenarios = list(_torture_scenarios())
+    assert len(scenarios) >= 200
+    for scenario_seed, site, torn in scenarios[12:]:
+        _run_scenario(scenario_seed, site, torn)

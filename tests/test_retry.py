@@ -97,3 +97,88 @@ def test_args_pass_through():
     clock = FakeClock()
     assert retry_call(lambda a, b=0: a + b, 2, b=3,
                       clock=clock) == 5
+
+
+# -- overall deadline (ISSUE 4 satellite) ----------------------------------
+
+def test_deadline_stops_before_overrunning_sleep():
+    """The schedule stops the moment the NEXT backoff would cross the
+    deadline — it never sleeps into certain failure, so the caller
+    gets the remaining time back."""
+    clock = FakeClock()
+    fn = Flaky(99)
+    with pytest.raises(RetryExhausted) as ei:
+        retry_call(fn, policy=RetryPolicy(attempts=10, base_delay=0.1,
+                                          multiplier=2.0, max_delay=10.0,
+                                          deadline=0.5),
+                   clock=clock)
+    # sleeps 0.1 + 0.2 = 0.3; the next 0.4 would cross 0.5 => stop
+    assert clock.sleeps == [0.1, 0.2]
+    assert ei.value.deadline_expired is True
+    assert ei.value.attempts == 3 < 10
+    assert ei.value.elapsed == pytest.approx(0.3)
+    assert "deadline expired" in str(ei.value)
+    assert "0.300s" in str(ei.value)
+
+
+def test_exhaustion_reports_elapsed_time():
+    clock = FakeClock()
+    with pytest.raises(RetryExhausted) as ei:
+        retry_call(Flaky(99), policy=RetryPolicy(attempts=3),
+                   clock=clock)
+    assert ei.value.deadline_expired is False
+    assert ei.value.elapsed == pytest.approx(0.01 + 0.02)
+    assert "in 0.030s" in str(ei.value)
+
+
+def test_deadline_unhit_when_schedule_fits():
+    clock = FakeClock()
+    fn = Flaky(2)
+    assert retry_call(fn, policy=RetryPolicy(attempts=4, deadline=10.0),
+                      clock=clock) == "ok"
+    assert clock.sleeps == [0.01, 0.02]
+
+
+def test_deadline_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(deadline=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(deadline=-1.0)
+
+
+# -- decorrelated jitter (ISSUE 4 satellite) -------------------------------
+
+def test_decorrelated_jitter_schedule_is_seeded_and_bounded():
+    import random
+    policy = RetryPolicy(attempts=8, base_delay=0.01, max_delay=0.5,
+                         jitter="decorrelated")
+    runs = []
+    for _ in range(2):
+        clock = FakeClock()
+        with pytest.raises(RetryExhausted):
+            retry_call(Flaky(99), policy=policy, clock=clock,
+                       rng=random.Random(1234))
+        runs.append(list(clock.sleeps))
+    assert runs[0] == runs[1]              # seeded => exact replay
+    assert len(runs[0]) == 7
+    for d in runs[0]:
+        assert policy.base_delay <= d <= policy.max_delay
+    # jittered: the walk must not be the pure exponential schedule
+    pure = [min(0.01 * 2.0 ** i, 0.5) for i in range(7)]
+    assert runs[0] != pure
+
+
+def test_decorrelated_jitter_walk_uses_prev_delay():
+    import random
+    policy = RetryPolicy(base_delay=0.01, max_delay=100.0,
+                         jitter="decorrelated")
+    rng = random.Random(7)
+    d0 = policy.delay(0, prev_delay=None, rng=rng)
+    d1 = policy.delay(1, prev_delay=d0, rng=rng)
+    assert 0.01 <= d0 <= 0.03               # U(base, base*3) first step
+    assert d1 <= max(0.01, d0 * 3.0)
+
+
+def test_jitter_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter="full")

@@ -355,3 +355,42 @@ def test_crushtool_add_item_validation(tmp_path):
             "--reweight-item", "osd.11", "2.0", "-o", mapfn)
     assert r.returncode == 0, r.stderr
     assert "reweight_item osd.11" in r.stderr
+
+def test_recovery_demo_churn_crash_torn():
+    """tools/recovery_demo.py: the churn+crash+torn recovery CLI — rc 0
+    with a converged byte-identical report under budget, rc 2 with the
+    structured unrecoverable report past it (the same gates
+    tools/test_full.sh enforces)."""
+    import os
+    script = os.path.join(REPO_ROOT, "tools", "recovery_demo.py")
+    r = subprocess.run([sys.executable, script, "--erasures", "1",
+                        "--corruptions", "1", "--churn", "3",
+                        "--crash-site", "writeback.after_write",
+                        "--torn", "--objects", "4", "--json"],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["byte_identical"] is True
+    assert out["report"]["converged"] is True
+    assert out["report"]["crashes"] == 1
+    assert out["report"]["journal"]["replays"] >= 2   # boot + resume
+    assert out["churn_events"]
+
+    # past the m=2 budget: structured unrecoverable report, rc 2
+    r = subprocess.run([sys.executable, script, "--erasures", "3",
+                        "--churn", "0", "--objects", "2", "--json"],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 2, r.stderr
+    out = json.loads(r.stdout)
+    assert out["report"]["unrecoverable"]
+    assert out["byte_identical"] is True    # survivors still intact
+
+
+def test_recovery_demo_list_sites():
+    import os
+    from ceph_tpu.chaos import CRASH_SITES
+    script = os.path.join(REPO_ROOT, "tools", "recovery_demo.py")
+    r = subprocess.run([sys.executable, script, "--list-sites"],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0
+    assert tuple(r.stdout.split()) == CRASH_SITES

@@ -18,4 +18,13 @@ python tools/scrub_demo.py --erasures 1 --corruptions 1 --transient 2 \
     >/dev/null || exit 1
 python tools/scrub_demo.py --erasures 3 --corruptions 1 >/dev/null 2>&1
 [ $? -eq 2 ] || { echo "scrub_demo: expected unrecoverable rc 2"; exit 1; }
+# Recovery-orchestrator end-to-end smoke (ISSUE 4): a seeded
+# churn+crash+torn-write scenario must converge byte-identical through
+# the epoch fence and the intent journal (rc 0), and a past-budget mix
+# must exit with the structured unrecoverable report (rc 2) — the full
+# torture sweep runs inside tier-1 as tests/test_recovery_churn.py.
+python tools/recovery_demo.py --erasures 1 --corruptions 1 --churn 3 \
+    --crash-site writeback.after_write --torn >/dev/null || exit 1
+python tools/recovery_demo.py --erasures 3 --churn 0 >/dev/null 2>&1
+[ $? -eq 2 ] || { echo "recovery_demo: expected unrecoverable rc 2"; exit 1; }
 CEPH_TPU_FULL=1 exec python -m pytest tests/ -q "$@"
