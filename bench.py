@@ -204,6 +204,22 @@ def _write_last_good(out: dict) -> None:
         pass  # persistence is best-effort; the stdout line is the record
 
 
+def _audit_meta() -> dict:
+    """Which code shapes this bench's numbers are certified for:
+    the tpu-audit entry-point registry size and trace-rule ids
+    (docs/LINT.md "Trace tier").  Declarative reads only — no jax
+    tracing at bench time; the audit itself gates tier-1."""
+    try:
+        from ceph_tpu.analysis.entrypoints import registry
+        from ceph_tpu.analysis.jaxpr_audit import AUDIT_RULE_IDS
+        return {
+            "audited_entrypoints": len(registry()),
+            "audit_rules": sorted(AUDIT_RULE_IDS),
+        }
+    except Exception:  # noqa: BLE001 — metadata must never kill a bench
+        return {"audited_entrypoints": None, "audit_rules": []}
+
+
 def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
                 host_gbps: float) -> dict:
     """The one-line JSON shape for runs that could not measure the
@@ -222,6 +238,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "host_gbps": round(host_gbps, 3),
         "degraded_rows": _degraded_rows(iterations=1, host_only=True),
         "last_good": _read_last_good(),
+        **_audit_meta(),
     }
 
 
@@ -380,6 +397,7 @@ def main() -> int:
         "degraded_rows": _degraded_rows(iterations=3),
         "vs_host_groundtruth": round(best["gbps"] / host["gbps"], 3)
         if host["gbps"] > 0 else None,
+        **_audit_meta(),
     }
     _write_last_good(out)
     print(json.dumps(out))

@@ -17,26 +17,59 @@ gate:
 - GF arithmetic misuse: Python *, %, ** on GF table values computes
   integer math where field math is required.
 
-Run ``python tools/tpu_lint.py [--json] [paths...]`` or use
-:func:`lint_paths`; suppress a deliberate pattern with
-``# tpu-lint: disable=<rule> -- reason``.  docs/LINT.md documents every
-rule and the relationship to the runtime sanitizer.
+The package carries BOTH static tiers of the three-tier sanitizer
+story (static AST → jaxpr trace → runtime byte-compare):
+
+- AST tier (rules.py / scanner.py): pure stdlib-ast, never imports the
+  scanned code, runs jax-free;
+- trace tier / tpu-audit (entrypoints.py / jaxpr_audit.py): traces
+  every registered jit-facing entry point to a ClosedJaxpr and walks
+  what XLA is *actually asked to run* — float-lane leaks through
+  helper chains, callbacks, baked transfers, weak-type cache poison,
+  primitive-set drift — plus a recompile sentinel with declared
+  per-entry trace budgets and a registry-completeness gate.
+
+Run ``python tools/tpu_lint.py [--json] [--trace] [paths...]`` or use
+:func:`lint_paths` / :func:`audit_registry`; suppress a deliberate
+pattern with ``# tpu-lint: disable=<rule> -- reason`` (shared syntax
+across both tiers; ``--check-suppressions`` flags stale pragmas).
+docs/LINT.md documents every rule and the tier division of labor.
 """
 
 from .config import LintConfig
 from .rules import ALL_RULES, Finding, Rule
 from .scanner import FileReport, LintReport, lint_file, lint_paths
-from .report import render_human, render_json
+from .report import (render_human, render_json, render_trace_human,
+                     render_trace_json)
+# trace tier (tpu-audit): declarative registry + jaxpr auditor.  These
+# modules import jax lazily (inside builders/auditor calls), so the
+# AST tier stays usable in jax-free environments.
+from .entrypoints import EntryPoint, registry, registry_gaps
+from .jaxpr_audit import (AUDIT_RULE_IDS, EntryAudit, TraceReport,
+                          audit_entry_point, audit_registry,
+                          run_sentinel, stale_trace_pragmas)
 
 __all__ = [
     "ALL_RULES",
+    "AUDIT_RULE_IDS",
+    "EntryAudit",
+    "EntryPoint",
     "FileReport",
     "Finding",
     "LintConfig",
     "LintReport",
     "Rule",
+    "TraceReport",
+    "audit_entry_point",
+    "audit_registry",
     "lint_file",
     "lint_paths",
+    "registry",
+    "registry_gaps",
     "render_human",
     "render_json",
+    "render_trace_human",
+    "render_trace_json",
+    "run_sentinel",
+    "stale_trace_pragmas",
 ]

@@ -1,0 +1,431 @@
+"""The jit-facing entry-point registry — what tpu-audit certifies.
+
+Every public surface that hands work to XLA is declared here with a
+representative workload: the five plugin families' device-resident
+encode/decode (byte and packed layouts), the engine dispatchers
+(``apply_matrix_best`` / ``apply_matrix_packed_best``), the raw Pallas
+kernels (interpret mode, so the kernel jaxpr itself is walked), the
+fused decode→re-encode repair call, CRUSH bulk rule evaluation, and
+scrub's batched CRC (a *host*-tier entry: its contract is that it never
+dispatches through jax at all).
+
+Each :class:`EntryPoint` declares:
+
+- ``build()`` → a :class:`Built` carrying the callable, concrete
+  representative args (small shapes — the audit is about code *shape*,
+  not throughput), and the anchor function whose source file/line the
+  findings attach to (``# tpu-lint: disable=audit-* -- reason`` pragmas
+  near the anchor suppress, same syntax as the AST tier);
+- ``allow`` — the expected jax primitive set for the family.  The
+  auditor fails loudly on drift: a new primitive in a traced hot path
+  is either a deliberate change (add it here, in review) or a
+  regression (a float promotion, a host callback) that neither the AST
+  linter nor the runtime verifier can see;
+- ``float_ok`` — primitives allowed to produce inexact dtypes inside a
+  GF-lane program (the whitelisted MXU bit-plane region; empty for
+  everything else);
+- ``trace_budget`` — compile-count ceiling for one cold run of the
+  workload (the recompile sentinel's declared budget; a warm repeat
+  must always be zero).
+
+The registry is *declarative*: importing this module never imports jax
+or the plugins — builders do, lazily — so the AST tier keeps working in
+jax-free environments.
+
+``registry_gaps()`` is the completeness gate: every public
+``*_chunks*_jax`` surface reachable on a representative instance of
+each family must be registered, so a new device surface cannot ship
+unaudited.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+
+# the five plugin families + the engine/ops/crush/scrub surfaces the
+# acceptance gate requires coverage for
+FAMILIES = ("jerasure", "isa", "shec", "lrc", "clay",
+            "engine", "ops", "crush", "scrub")
+
+# public device surfaces a plugin family can expose; the completeness
+# check requires every one present on a family's representative
+# instance to be registered
+PLUGIN_SURFACES = ("encode_chunks_jax", "decode_chunks_jax",
+                   "encode_chunks_packed_jax", "decode_chunks_packed_jax")
+
+B = 2          # representative batch
+C = 4096       # representative chunk bytes (packed R = C/512 = 8 rows)
+R = C // 512
+
+REPRESENTATIVE_PROFILES: Dict[str, Tuple[str, Dict[str, str]]] = {
+    # family -> (plugin name, profile) — mirrors the tier-1 test
+    # matrices; small geometries, every code path identical to prod
+    "jerasure": ("jerasure", {"technique": "reed_sol_van",
+                              "k": "4", "m": "2"}),
+    "jerasure_cauchy": ("jerasure", {"technique": "cauchy_good",
+                                     "k": "4", "m": "2",
+                                     "packetsize": "512"}),
+    "isa": ("isa", {"k": "4", "m": "2"}),
+    "shec": ("shec", {"k": "4", "m": "3", "c": "2"}),
+    "lrc": ("lrc", {"k": "4", "m": "2", "l": "3"}),
+    "clay": ("clay", {"k": "4", "m": "2", "d": "5"}),
+}
+
+
+@dataclasses.dataclass
+class Built:
+    """One buildable workload: the traced callable, its concrete
+    representative args, and the source anchor findings attach to."""
+    fn: Callable
+    args: tuple
+    anchor: Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    name: str                       # "clay.decode_chunks_jax"
+    family: str                     # one of FAMILIES
+    kind: str                       # "jit" | "host"
+    build: Callable[[], Built]
+    # expected primitive names (recursive over sub-jaxprs); None =
+    # allowlist rule skipped (never used by registered entries — kept
+    # for synthetic test entries)
+    allow: Optional[FrozenSet[str]] = None
+    # primitives allowed to carry inexact dtypes (MXU bit-plane region)
+    float_ok: FrozenSet[str] = frozenset()
+    # compile ceiling for one cold workload run (sentinel budget)
+    trace_budget: int = 8
+
+
+# ----------------------------------------------------------------------
+# shared instance cache (builders are called repeatedly: audit once,
+# sentinel twice)
+
+_EC_CACHE: Dict[str, object] = {}
+
+
+def representative_instance(family: str):
+    """The family's representative plugin instance (cached)."""
+    ec = _EC_CACHE.get(family)
+    if ec is None:
+        from ..codes.registry import ErasureCodePluginRegistry
+        plugin, profile = REPRESENTATIVE_PROFILES[family]
+        ec = ErasureCodePluginRegistry.instance().factory(
+            plugin, dict(profile))
+        _EC_CACHE[family] = ec
+    return ec
+
+
+def _erasure_pattern(ec):
+    """Erase shard 1 — every family can repair one loss."""
+    n = ec.get_chunk_count()
+    erased = (1,)
+    available = tuple(i for i in range(n) if i != 1)
+    return available, erased
+
+
+# ----------------------------------------------------------------------
+# expected primitive sets (discovered by tracing on the pinned jax,
+# reviewed, and baked — drift fails audit-primitive-allowlist).
+#
+# The SWAR XLA matrix path: u8<->u32 bitcasts + the shift/xor/and/mul
+# xtime ladder under a pjit wrapper, plus the static slice/concat
+# plumbing the plugin surfaces add around it (transpose: the bitmatrix
+# packet relayout).  Deliberately absent: gather / select_n /
+# device_put — static index selection must lower to slices
+# (ops/xla_ops.py::take_static), and any dynamic indirection in a GF
+# program is drift worth reviewing.
+
+GF_XLA_PRIMS = frozenset({
+    "pjit", "bitcast_convert_type", "reshape", "broadcast_in_dim",
+    "concatenate", "slice", "squeeze", "transpose",
+    "xor", "and", "or", "mul", "shift_left", "shift_right_logical",
+})
+
+# packed resident layout: same math, same set (the byte-view casts are
+# bitcasts already in GF_XLA_PRIMS)
+GF_PACKED_PRIMS = GF_XLA_PRIMS
+
+# Pallas kernels traced in interpret mode additionally carry the
+# interpreter's ref load/store primitives and the register pack's
+# convert_element_type
+GF_PALLAS_PRIMS = GF_XLA_PRIMS | frozenset({
+    "pallas_call", "get", "swap", "convert_element_type", "pad",
+})
+
+# The MXU bit-sliced matmul: bit-plane expansion + one einsum.  Its
+# float use is declared (float_ok), NOT absent — audit-float-lane
+# checks every primitive around the sanctioned region (transpose: the
+# einsum lowering moves the bf16 operand before the dot).
+MXU_FLOAT_OK = frozenset({"convert_element_type", "dot_general",
+                          "transpose"})
+GF_MXU_PRIMS = GF_XLA_PRIMS | frozenset({
+    "dot_general", "add", "iota", "select_n", "eq", "ne", "lt",
+    "transpose", "reduce_sum", "dynamic_slice", "pad", "gather",
+    "convert_element_type",
+})
+
+# CRUSH bulk rule evaluation: straw2 fixed-point draws, rjenkins hash
+# mixing, candidate-grid scans/fixpoints — integer end to end (gather
+# IS expected here: bucket item lookup is genuinely dynamic in x)
+CRUSH_BULK_PRIMS = frozenset({
+    "pjit", "broadcast_in_dim", "reshape", "concatenate", "squeeze",
+    "slice", "gather", "scatter", "transpose", "convert_element_type",
+    "iota", "add", "sub", "mul", "neg", "sign", "and", "or", "xor",
+    "not", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "eq", "ne", "lt", "le", "gt", "ge",
+    "select_n", "max", "min", "rem", "div",
+    "reduce_and", "reduce_or", "reduce_max", "reduce_min",
+    "reduce_sum", "argmax", "argmin", "scan", "while", "cond",
+    "clamp", "dynamic_slice", "dynamic_update_slice", "pad",
+})
+
+
+# ----------------------------------------------------------------------
+# builders
+
+def _plugin_surface_builder(family: str, surface: str) -> Callable[[], Built]:
+    def build() -> Built:
+        import numpy as np
+
+        ec = representative_instance(family)
+        k = ec.get_data_chunk_count()
+        available, erased = _erasure_pattern(ec)
+        anchor = getattr(type(ec), surface)
+        if surface == "encode_chunks_jax":
+            args = (np.zeros((B, k, C), np.uint8),)
+            fn = ec.encode_chunks_jax
+        elif surface == "decode_chunks_jax":
+            args = (np.zeros((B, len(available), C), np.uint8),)
+            fn = (lambda chunks, _ec=ec, _a=available, _e=erased:
+                  _ec.decode_chunks_jax(chunks, _a, _e))
+        elif surface == "encode_chunks_packed_jax":
+            args = (np.zeros((B, k, R, 128), np.uint32),)
+            fn = ec.encode_chunks_packed_jax
+        else:  # decode_chunks_packed_jax
+            args = (np.zeros((B, len(available), R, 128), np.uint32),)
+            fn = (lambda words, _ec=ec, _a=available, _e=erased:
+                  _ec.decode_chunks_packed_jax(words, _a, _e))
+        return Built(fn, args, anchor)
+
+    return build
+
+
+def _rs_static():
+    """The jerasure RS (m, k) coding matrix as the hashable static
+    tuple — the representative small matrix for the ops entries."""
+    from ..ops.xla_ops import matrix_to_static
+
+    ec = representative_instance("jerasure")
+    return matrix_to_static(ec.matrix)
+
+
+def _build_apply_matrix_best() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_matrix_best
+
+    ms = _rs_static()
+    return Built(lambda x: apply_matrix_best(x, ms, 8),
+                 (np.zeros((B, 4, C), np.uint8),), apply_matrix_best)
+
+
+def _build_apply_matrix_packed_best() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_matrix_packed_best
+
+    ms = _rs_static()
+    return Built(lambda x: apply_matrix_packed_best(x, ms),
+                 (np.zeros((B, 4, R, 128), np.uint32),),
+                 apply_matrix_packed_best)
+
+
+def _build_pallas_byte() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_matrix_pallas
+
+    ms = _rs_static()
+    return Built(lambda x: apply_matrix_pallas(x, ms, True),
+                 (np.zeros((B, 4, C), np.uint8),), apply_matrix_pallas)
+
+
+def _build_pallas_packed() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_matrix_pallas_packed
+
+    ms = _rs_static()
+    return Built(lambda x: apply_matrix_pallas_packed(x, ms, True),
+                 (np.zeros((B, 4, R, 128), np.uint32),),
+                 apply_matrix_pallas_packed)
+
+
+def _build_apply_matrix_mxu() -> Built:
+    """The MXU bit-sliced GF(2) matmul, traced directly (the selection
+    table only routes composites here on TPU, so the deterministic
+    XLA-tier audit must reach it explicitly).  Its bf16/f32 use is the
+    ONE sanctioned float region — exact by construction (0/1 planes,
+    integral f32 sums; ops/xla_ops.py) — declared via float_ok rather
+    than pragma-suppressed, so audit-float-lane still guards every
+    primitive around it."""
+    import numpy as np
+
+    from ..ops.xla_ops import apply_matrix_mxu
+
+    ms = _rs_static()
+    return Built(lambda x: apply_matrix_mxu(x, ms),
+                 (np.zeros((B, 4, C), np.uint8),), apply_matrix_mxu)
+
+
+def _build_pallas_bitmatrix() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_bitmatrix_pallas
+    from ..ops.xla_ops import bitmatrix_to_static
+
+    ec = representative_instance("jerasure_cauchy")
+    rows = bitmatrix_to_static(ec.bitmatrix)
+    w, packetsize = ec.w, 512
+    return Built(lambda x: apply_bitmatrix_pallas(x, rows, w, packetsize,
+                                                  True),
+                 (np.zeros((B, 4, w * packetsize), np.uint8),),
+                 apply_bitmatrix_pallas)
+
+
+def _build_fused_repair() -> Built:
+    import numpy as np
+
+    from ..codes.engine import fused_repair_call
+
+    ec = representative_instance("jerasure")
+    available, erased = _erasure_pattern(ec)
+    fn = fused_repair_call(ec, available, erased)
+    return Built(fn, (np.zeros((B, len(available), C), np.uint8),),
+                 fused_repair_call)
+
+
+_CRUSH_CACHE: dict = {}
+
+
+def _build_crush_bulk() -> Built:
+    import numpy as np
+
+    hit = _CRUSH_CACHE.get("bulk")
+    if hit is None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..crush import (CrushBuilder, step_chooseleaf_indep,
+                             step_emit, step_take)
+        from ..crush.bulk import CompiledCrushMap, compile_rule
+
+        b = CrushBuilder()
+        root = b.build_two_level(4, 2)
+        b.add_rule(0, [step_take(root), step_chooseleaf_indep(0, 1),
+                       step_emit()])
+        cm = CompiledCrushMap(b.map)
+        fn = compile_rule(cm, 0, 3)
+        jf = jax.jit(jax.vmap(fn, in_axes=(0, None)))
+        wv = jnp.asarray(np.asarray(b.map.device_weights(),
+                                    dtype=np.int64))
+        xs = jnp.asarray(np.arange(8, dtype=np.int64))
+        hit = (jf, xs, wv, compile_rule)
+        _CRUSH_CACHE["bulk"] = hit
+    jf, xs, wv, anchor = hit
+    return Built(jf, (xs, wv), anchor)
+
+
+def _build_crc_batch() -> Built:
+    import numpy as np
+
+    from ..codes.stripe import ceph_crc32c_batch
+
+    crcs = np.full(B, 0xFFFFFFFF, np.uint32)
+    bufs = np.zeros((B, 2 * C), np.uint8)
+    return Built(ceph_crc32c_batch, (crcs, bufs), ceph_crc32c_batch)
+
+
+# ----------------------------------------------------------------------
+# THE registry
+
+def _plugin_entries() -> List[EntryPoint]:
+    entries: List[EntryPoint] = []
+    surfaces = {
+        "jerasure": PLUGIN_SURFACES,
+        "jerasure_cauchy": ("encode_chunks_jax", "decode_chunks_jax"),
+        "isa": PLUGIN_SURFACES,
+        "shec": ("encode_chunks_jax", "decode_chunks_jax",
+                 "encode_chunks_packed_jax", "decode_chunks_packed_jax"),
+        "lrc": PLUGIN_SURFACES,
+        "clay": PLUGIN_SURFACES,
+    }
+    for family, surfs in surfaces.items():
+        base = family.split("_")[0] if family != "jerasure_cauchy" \
+            else "jerasure"
+        for surface in surfs:
+            entries.append(EntryPoint(
+                name=f"{family}.{surface}",
+                family=base,
+                kind="jit",
+                build=_plugin_surface_builder(family, surface),
+                allow=GF_PACKED_PRIMS if "packed" in surface
+                else GF_XLA_PRIMS,
+                trace_budget=24,
+            ))
+    return entries
+
+
+def registry() -> Tuple[EntryPoint, ...]:
+    """Every audited entry point, in deterministic audit order."""
+    entries = _plugin_entries()
+    entries += [
+        EntryPoint("ops.apply_matrix_best", "ops", "jit",
+                   _build_apply_matrix_best, allow=GF_XLA_PRIMS,
+                   trace_budget=16),
+        EntryPoint("ops.apply_matrix_packed_best", "ops", "jit",
+                   _build_apply_matrix_packed_best,
+                   allow=GF_PACKED_PRIMS, trace_budget=16),
+        EntryPoint("ops.apply_matrix_pallas", "ops", "jit",
+                   _build_pallas_byte, allow=GF_PALLAS_PRIMS,
+                   trace_budget=16),
+        EntryPoint("ops.apply_matrix_pallas_packed", "ops", "jit",
+                   _build_pallas_packed, allow=GF_PALLAS_PRIMS,
+                   trace_budget=16),
+        EntryPoint("ops.apply_bitmatrix_pallas", "ops", "jit",
+                   _build_pallas_bitmatrix, allow=GF_PALLAS_PRIMS,
+                   trace_budget=16),
+        EntryPoint("ops.apply_matrix_mxu", "ops", "jit",
+                   _build_apply_matrix_mxu, allow=GF_MXU_PRIMS,
+                   float_ok=MXU_FLOAT_OK, trace_budget=16),
+        EntryPoint("engine.fused_repair_call", "engine", "jit",
+                   _build_fused_repair, allow=GF_XLA_PRIMS,
+                   trace_budget=16),
+        EntryPoint("crush.bulk_rule", "crush", "jit",
+                   _build_crush_bulk, allow=CRUSH_BULK_PRIMS,
+                   trace_budget=24),
+        EntryPoint("scrub.ceph_crc32c_batch", "scrub", "host",
+                   _build_crc_batch, allow=None, trace_budget=0),
+    ]
+    return tuple(entries)
+
+
+def registry_names() -> List[str]:
+    return [e.name for e in registry()]
+
+
+def registry_gaps() -> List[str]:
+    """Public plugin device surfaces missing from the registry — the
+    completeness gate (a new ``*_chunks*_jax`` surface on any family's
+    representative class MUST be declared here to ship)."""
+    registered = {e.name for e in registry()}
+    gaps: List[str] = []
+    for family in REPRESENTATIVE_PROFILES:
+        ec = representative_instance(family)
+        for surface in PLUGIN_SURFACES:
+            if callable(getattr(type(ec), surface, None)) \
+                    and f"{family}.{surface}" not in registered:
+                gaps.append(f"{family}.{surface}")
+    return gaps

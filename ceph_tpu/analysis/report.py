@@ -11,11 +11,15 @@ from .rules import ALL_RULES
 from .scanner import LintReport
 
 
-def render_human(report: LintReport, show_suppressed: bool = False) -> str:
+def render_human(report: LintReport, show_suppressed: bool = False,
+                 show_stale: bool = False) -> str:
     lines: List[str] = []
     for fr in report.files:
         for f in fr.findings:
             lines.append(f.render())
+        if show_stale:
+            for f in fr.stale:
+                lines.append(f.render())
         if show_suppressed:
             for f in fr.suppressed:
                 reason = f" ({f.suppress_reason})" if f.suppress_reason \
@@ -24,9 +28,10 @@ def render_human(report: LintReport, show_suppressed: bool = False) -> str:
     n_files = len(report.files)
     n = len(report.findings)
     ns = len(report.suppressed)
+    stale = f", {len(report.stale)} stale" if show_stale else ""
     lines.append(
         f"tpu-lint: {n} finding{'s' if n != 1 else ''} "
-        f"({ns} suppressed) in {n_files} file"
+        f"({ns} suppressed{stale}) in {n_files} file"
         f"{'s' if n_files != 1 else ''}")
     return "\n".join(lines)
 
@@ -36,6 +41,7 @@ def render_json(report: LintReport) -> str:
         "files": len(report.files),
         "findings": [f.as_dict() for f in report.findings],
         "suppressed": [f.as_dict() for f in report.suppressed],
+        "stale": [f.as_dict() for f in report.stale],
         "ok": report.ok,
     }
     return json.dumps(payload, indent=2, sort_keys=True)
@@ -47,3 +53,68 @@ def render_rules() -> str:
         lines.append(f"{rule.id} [{rule.category}]")
         lines.append(f"    {rule.description}")
     return "\n".join(lines)
+
+
+# -- trace tier (tpu-audit) ---------------------------------------------
+
+def render_trace_human(report, show_suppressed: bool = False,
+                       show_stale: bool = False) -> str:
+    """Human report for a jaxpr_audit.TraceReport: one status line per
+    entry point, findings grep-able in the AST tier's format."""
+    lines: List[str] = []
+    for e in report.entries:
+        sent = ""
+        if e.cold_compiles is not None:
+            sent = (f" cold={e.cold_compiles}"
+                    f" warm={e.warm_compiles}")
+        status = "ok" if e.ok else "FAIL"
+        lines.append(f"  {status:4s} {e.name} [{e.kind}]"
+                     f" eqns={e.n_eqns}{sent}")
+        for f in e.findings:
+            lines.append(f.render())
+        if show_suppressed:
+            for f in e.suppressed:
+                reason = f" ({f.suppress_reason})" if f.suppress_reason \
+                    else ""
+                lines.append(f"{f.render()} [suppressed{reason}]")
+    for gap in report.gaps:
+        lines.append(f"<registry>:0:0: [audit-registry-gap] public "
+                     f"device surface '{gap}' is not declared in "
+                     f"analysis/entrypoints.py")
+    if show_stale:
+        for f in report.stale:
+            lines.append(f.render())
+    n = len(report.findings)
+    ns = len(report.suppressed)
+    stale = f", {len(report.stale)} stale" if show_stale else ""
+    lines.append(
+        f"tpu-audit: {len(report.entries)} entry points audited, "
+        f"{n} finding{'s' if n != 1 else ''} ({ns} suppressed{stale}), "
+        f"{len(report.gaps)} registry gap"
+        f"{'s' if len(report.gaps) != 1 else ''}")
+    return "\n".join(lines)
+
+
+def render_trace_json(report, show_stale: bool = False) -> str:
+    payload = {
+        "entries": [
+            {
+                "name": e.name,
+                "family": e.family,
+                "kind": e.kind,
+                "ok": e.ok,
+                "n_eqns": e.n_eqns,
+                "primitives": dict(sorted(e.primitives.items())),
+                "cold_compiles": e.cold_compiles,
+                "warm_compiles": e.warm_compiles,
+                "findings": [f.as_dict() for f in e.findings],
+                "suppressed": [f.as_dict() for f in e.suppressed],
+            }
+            for e in report.entries
+        ],
+        "gaps": list(report.gaps),
+        "stale": [f.as_dict() for f in report.stale] if show_stale
+        else [],
+        "ok": report.ok,
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

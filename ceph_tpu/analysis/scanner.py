@@ -27,6 +27,9 @@ class FileReport:
     findings: List[Finding]            # unsuppressed
     suppressed: List[Finding]
     parse_error: Optional[str] = None
+    # `# tpu-lint: disable=` pragmas (AST-tier rules only) that no
+    # longer suppress anything — reported by `--check-suppressions`
+    stale: List[Finding] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -40,6 +43,10 @@ class LintReport:
     @property
     def suppressed(self) -> List[Finding]:
         return [f for fr in self.files for f in fr.suppressed]
+
+    @property
+    def stale(self) -> List[Finding]:
+        return [f for fr in self.files for f in fr.stale]
 
     @property
     def ok(self) -> bool:
@@ -125,7 +132,31 @@ def lint_source(source: str, rel_path: str,
                 live.append(finding)
     live.sort(key=lambda f: (f.line, f.col, f.rule))
     suppressed.sort(key=lambda f: (f.line, f.col, f.rule))
-    return FileReport(rel_path, live, suppressed)
+    return FileReport(rel_path, live, suppressed,
+                      stale=_stale_findings(rel_path, pragmas, config))
+
+
+def _stale_findings(rel_path: str, pragmas, config: LintConfig
+                    ) -> List[Finding]:
+    """``disable=`` pragmas whose AST-tier rules matched nothing this
+    scan.  Trace-tier (``audit-*``) pragmas are the jaxpr auditor's to
+    judge (jaxpr_audit.stale_trace_pragmas); skipped here.  Only
+    meaningful on full-rule runs: a ``--rule``-filtered scan never
+    marks the other rules' pragmas stale."""
+    if config.enabled_rules is not None:
+        return []
+    out: List[Finding] = []
+    for s in pragmas.suppressions:
+        for rule in sorted(s.stale_rules()):
+            if rule.startswith("audit-") or rule in config.disabled_rules:
+                continue
+            line = s.line or 1
+            reason = f" -- {s.reason}" if s.reason else ""
+            out.append(Finding(
+                "stale-suppression", rel_path, line, 0, line,
+                f"suppression for '{rule}' no longer matches any "
+                f"finding{reason}"))
+    return out
 
 
 def lint_paths(paths: Sequence[str],

@@ -42,6 +42,10 @@ class Suppression:
     line: int                 # line the suppression applies to (0 = file)
     reason: str = ""
     used: bool = False
+    # which listed rules actually suppressed a finding — the
+    # per-rule grain behind `--check-suppressions` (a pragma listing
+    # two rules where only one still fires is half-stale)
+    used_rules: Set[str] = dataclasses.field(default_factory=set)
 
     def matches(self, rule_id: str, start: int, end: int) -> bool:
         if rule_id not in self.rules and "all" not in self.rules:
@@ -49,6 +53,17 @@ class Suppression:
         if self.line == 0:
             return True
         return start <= self.line <= end
+
+    def record_use(self, rule_id: str) -> None:
+        self.used = True
+        self.used_rules.add(rule_id if rule_id in self.rules else "all")
+
+    def stale_rules(self) -> Set[str]:
+        """Listed rules that never suppressed anything (for ``all``:
+        the whole pragma iff nothing matched)."""
+        if "all" in self.rules:
+            return set() if self.used_rules else {"all"}
+        return self.rules - self.used_rules
 
 
 @dataclasses.dataclass
@@ -61,7 +76,7 @@ class PragmaInfo:
                         end: int) -> Optional[Suppression]:
         for s in self.suppressions:
             if s.matches(rule_id, start, end):
-                s.used = True
+                s.record_use(rule_id)
                 return s
         return None
 

@@ -224,19 +224,23 @@ class ErasureCodeShec(MatrixCodeMixin, ErasureCode):
         lesson the encode path learned in round 3; this was the shec
         decode row's 17 GB/s bottleneck)."""
         from ...ops.pallas_gf import apply_matrix_best
-        from ...ops.xla_ops import jax_bytes_view, jax_words_view
+        from ...ops.xla_ops import (jax_bytes_view, jax_words_view,
+                                    take_static)
         plan = self.tcache.get_plan(self.matrix, self.k, self.w,
                                     frozenset(available), frozenset(erased))
         aidx = {c: t for t, c in enumerate(available)}
         sel = [aidx[c] for c in plan.reads]
         worder = {c: t for t, c in enumerate(plan.want_order)}
         _, dm_static, _ = self._plan_static(plan)
-        sub = chunks[:, np.array(sel), :]
+        # static column selection, not np fancy indexing: the plan's
+        # read/want orders are trace-time constants, and a gather here
+        # bakes a device_put + dynamic indirection into the program
+        # (tpu-audit: audit-transfer)
+        sub = take_static(chunks, sel, axis=1)
         words = jax_words_view(sub, self.w)
         out = apply_matrix_best(words, dm_static, self.w)
         out = jax_bytes_view(out)
-        keep = np.array([worder[c] for c in erased])
-        return out[:, keep, :]
+        return take_static(out, [worder[c] for c in erased], axis=1)
 
     def decode_chunks_packed_jax(self, words, available: tuple,
                                  erased: tuple):
@@ -248,15 +252,16 @@ class ErasureCodeShec(MatrixCodeMixin, ErasureCode):
         if self.w != 8:
             raise ValueError("packed layout is w=8 only")
         from ...ops.pallas_gf import apply_matrix_packed_best
+        from ...ops.xla_ops import take_static
         plan = self.tcache.get_plan(self.matrix, self.k, self.w,
                                     frozenset(available), frozenset(erased))
         aidx = {c: t for t, c in enumerate(available)}
-        sel = np.array([aidx[c] for c in plan.reads])
+        sel = [aidx[c] for c in plan.reads]
         worder = {c: t for t, c in enumerate(plan.want_order)}
         _, dm_static, _ = self._plan_static(plan)
-        out = apply_matrix_packed_best(words[:, sel], dm_static)
-        keep = np.array([worder[c] for c in erased])
-        return out[:, keep]
+        out = apply_matrix_packed_best(take_static(words, sel, axis=1),
+                                       dm_static)
+        return take_static(out, [worder[c] for c in erased], axis=1)
 
 
 class ErasureCodeShecReedSolomonVandermonde(ErasureCodeShec):

@@ -150,6 +150,28 @@ def encode_matrix_xla(data: jax.Array, matrix, w: int = 8) -> jax.Array:
     return apply_matrix_xla(data, matrix_to_static(matrix), w)
 
 
+def take_static(x: jax.Array, idx, axis: int = 1) -> jax.Array:
+    """Select rows along ``axis`` by a STATIC index list without a
+    device gather.
+
+    ``x[:, np.array(idx)]`` inside a traced function lowers to
+    ``device_put`` of the index constant plus a dynamic ``gather``
+    with clamp/select plumbing — a host constant and indirection baked
+    into the program for what is, with static indices, pure data
+    movement (tpu-audit rule ``audit-transfer`` flags it).  A
+    contiguous run lowers to one ``lax.slice``; anything else becomes
+    unit slices + one concatenate, all shape-static."""
+    idx = [int(i) for i in idx]
+    if not idx:
+        return jax.lax.slice_in_dim(x, 0, 0, axis=axis)
+    if idx == list(range(idx[0], idx[0] + len(idx))):
+        return jax.lax.slice_in_dim(x, idx[0], idx[0] + len(idx),
+                                    axis=axis)
+    return jnp.concatenate(
+        [jax.lax.slice_in_dim(x, i, i + 1, axis=axis) for i in idx],
+        axis=axis)
+
+
 def jax_words_view(data: jax.Array, w: int) -> jax.Array:
     """(..., C) uint8 device array -> (..., C/(w/8)) w-bit word view (bitcast)."""
     if w == 8:

@@ -1,0 +1,22 @@
+# tpu-lint: scope=gf
+"""RED fixture for --check-suppressions: both pragmas below are
+stale — the code they annotate no longer trips the rules they name,
+so the suppressions suppress nothing and must be flagged."""
+
+GF_POLY = 0x11D
+
+
+# tpu-lint: disable=gf-float -- stale: the float ladder was removed
+def xtime(v: int) -> int:
+    v <<= 1
+    if v & 0x100:
+        v ^= GF_POLY
+    return v & 0xFF
+
+
+def fold(vals):
+    acc = 0
+    for v in vals:
+        # tpu-lint: disable=host-sync -- stale: no jit region here
+        acc ^= xtime(v)
+    return acc

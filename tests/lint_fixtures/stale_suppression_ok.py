@@ -1,0 +1,10 @@
+# tpu-lint: scope=gf
+"""GREEN fixture for --check-suppressions: the pragma below still
+suppresses a live gf-float finding, so it is NOT stale."""
+
+import numpy as np
+
+
+def scale(table: np.ndarray) -> np.ndarray:
+    # tpu-lint: disable=gf-float -- fixture: deliberate float use
+    return table.astype(np.float32)

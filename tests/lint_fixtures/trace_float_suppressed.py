@@ -1,0 +1,11 @@
+"""Trace-tier suppression fixture: the function below leaks a float
+dtype into a GF-lane program, and the pragma suppresses the
+``audit-float-lane`` finding with the shared AST-tier syntax (the
+auditor anchors findings to this def and reads this file's pragmas)."""
+
+import jax.numpy as jnp
+
+
+# tpu-lint: disable=audit-float-lane -- fixture: deliberate float leak
+def float_leak(x):
+    return x.astype(jnp.float32).astype(jnp.uint8)

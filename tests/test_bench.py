@@ -276,6 +276,23 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     assert "packed" in rows["clay_k8_m4_d11_e1"]
 
 
+def test_bench_metadata_records_audit_coverage(monkeypatch):
+    """Every emitted line (headline and tunnel-down error alike)
+    records which code shapes were certified: the tpu-audit registry
+    size and trace-rule ids (ISSUE 5)."""
+    import bench
+    monkeypatch.setattr(bench, "_degraded_rows",
+                        lambda iterations, host_only=False: {})
+    meta = bench._audit_meta()
+    assert meta["audited_entrypoints"] >= 12
+    assert meta["audit_rules"] == sorted([
+        "audit-float-lane", "audit-callback", "audit-transfer",
+        "audit-weak-type", "audit-primitive-allowlist"])
+    err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
+    assert err["audited_entrypoints"] == meta["audited_entrypoints"]
+    assert err["audit_rules"] == meta["audit_rules"]
+
+
 def test_bench_last_good_roundtrip(tmp_path, monkeypatch):
     """bench.py persists every successful device line to
     BENCH_LAST_GOOD.json and embeds it in the tunnel-down error line —

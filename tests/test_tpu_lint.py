@@ -208,3 +208,110 @@ def test_rule_registry_consistent():
     assert set(RULES_BY_ID) == set(RULE_IDS)
     for rule in ALL_RULES:
         assert rule.id and rule.description and rule.category
+
+
+# ----------------------------------------------------------------------
+# --check-suppressions: stale pragma detection (AST tier; the trace
+# tier's half lives in test_jaxpr_audit.py)
+
+def test_stale_suppression_red_fixture():
+    rep = lint_file(os.path.join(FIXTURES, "stale_suppression_bad.py"))
+    assert not rep.findings          # nothing live...
+    stale = {(f.line, f.message.split("'")[1]) for f in rep.stale}
+    assert {r for _, r in stale} == {"gf-float", "host-sync"}
+
+
+def test_stale_suppression_green_fixture():
+    rep = lint_file(os.path.join(FIXTURES, "stale_suppression_ok.py"))
+    assert not rep.findings
+    assert rep.stale == []
+    assert [f.rule for f in rep.suppressed] == ["gf-float"]
+
+
+def test_half_stale_pragma_flags_only_the_dead_rule():
+    # one pragma, two rules, one still firing: only the dead rule is
+    # stale (per-rule grain)
+    src = ("# tpu-lint: scope=gf\n"
+           "import numpy as np\n"
+           "def f(t):\n"
+           "    # tpu-lint: disable=gf-float,host-sync -- mixed\n"
+           "    return t.astype(np.float32)\n")
+    rep = lint_source(src, "ceph_tpu/gf/x.py")
+    assert not rep.findings
+    assert [f.rule for f in rep.suppressed] == ["gf-float"]
+    assert len(rep.stale) == 1
+    assert "'host-sync'" in rep.stale[0].message
+
+
+def test_stale_check_skips_trace_pragmas():
+    # audit-* pragmas belong to the jaxpr tier; the AST scanner must
+    # not call them stale just because no AST rule matches
+    src = ("# tpu-lint: disable=audit-float-lane -- trace tier owns it\n"
+           "def f(x):\n"
+           "    return x\n")
+    rep = lint_source(src, "ceph_tpu/codes/x.py")
+    assert rep.stale == []
+
+
+def test_repo_has_no_stale_suppressions():
+    report = lint_paths([os.path.join(ROOT, "ceph_tpu"),
+                         os.path.join(ROOT, "tools")])
+    assert report.stale == [], \
+        "\n".join(f.render() for f in report.stale)
+
+
+def test_cli_check_suppressions_red_green(tmp_path):
+    cli = os.path.join(ROOT, "tools", "tpu_lint.py")
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    import shutil
+    shutil.copy(os.path.join(FIXTURES, "stale_suppression_bad.py"),
+                bad / "mod.py")
+    r = subprocess.run(
+        [sys.executable, cli, "--check-suppressions", str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "stale-suppression" in r.stdout
+    # same tree WITHOUT the flag still passes (stale is opt-in)
+    r2 = subprocess.run(
+        [sys.executable, cli, str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    good = tmp_path / "good"
+    good.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "stale_suppression_ok.py"),
+                good / "mod.py")
+    r3 = subprocess.run(
+        [sys.executable, cli, "--check-suppressions", str(good)],
+        capture_output=True, text=True, timeout=120)
+    assert r3.returncode == 0, r3.stdout + r3.stderr
+
+
+def test_cli_json_carries_stale_block(tmp_path):
+    import shutil
+    shutil.copy(os.path.join(FIXTURES, "stale_suppression_bad.py"),
+                tmp_path / "mod.py")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_lint.py"),
+         "--json", "--check-suppressions", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    payload = json.loads(r.stdout)
+    assert payload["ok"] is True          # ok tracks live findings...
+    assert len(payload["stale"]) == 2     # ...stale reported separately
+    assert r.returncode == 1              # ...but still fails the run
+
+
+def test_cli_trace_entry_smoke():
+    # one tiny entry through the real CLI: --trace plumbing end to end
+    # (the full-registry gate runs in-process in test_jaxpr_audit.py)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpu_lint.py"),
+         "--trace", "--no-sentinel", "--entry", "ops.apply_matrix_best",
+         "--json"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["ok"] is True
+    assert payload["entries"][0]["name"] == "ops.apply_matrix_best"
+    assert payload["entries"][0]["primitives"]

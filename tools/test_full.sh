@@ -8,8 +8,16 @@ cd "$(dirname "$0")/.."
 # it here too makes a lint regression fail in seconds, not minutes.)
 # bench.py rides along so the round-artifact driver is linted too —
 # everything under ceph_tpu/ and tools/ (including any new files) is
-# already covered by the directory walks.
-python tools/tpu_lint.py ceph_tpu/ tools/ bench.py || exit 1
+# already covered by the directory walks.  --check-suppressions also
+# fails the run on stale `# tpu-lint: disable=` pragmas.
+python tools/tpu_lint.py --check-suppressions ceph_tpu/ tools/ bench.py \
+    || exit 1
+# Trace gate second (ISSUE 5): tpu-audit traces every registered
+# jit-facing entry point (analysis/entrypoints.py) to a jaxpr, runs
+# the audit-* rules + the recompile sentinel, and fails if a public
+# plugin device surface is missing from the registry.  Same gate runs
+# in tier-1 as tests/test_jaxpr_audit.py.
+python tools/tpu_lint.py --trace --check-suppressions || exit 1
 # Chaos/scrub end-to-end smoke (docs/ROBUSTNESS.md): a recoverable
 # fault mix must heal (rc 0) and a past-budget mix must fail with the
 # structured unrecoverable report (rc 2) — in seconds, before the full

@@ -1,19 +1,29 @@
 #!/usr/bin/env python3
-"""tpu-lint CLI — static device-invariant checks for ceph_tpu.
+"""tpu-lint CLI — static + trace-tier device-invariant checks.
 
 Usage:
-    python tools/tpu_lint.py [paths...]        # default: ceph_tpu/
+    python tools/tpu_lint.py [paths...]        # AST tier (default: ceph_tpu/)
     python tools/tpu_lint.py --json ceph_tpu/  # machine-readable
     python tools/tpu_lint.py --list-rules
     python tools/tpu_lint.py --show-suppressed ceph_tpu/ops
+    python tools/tpu_lint.py --check-suppressions ceph_tpu/ tools/
+    python tools/tpu_lint.py --trace           # jaxpr audit (needs jax)
+    python tools/tpu_lint.py --trace --entry clay.decode_chunks_jax
+    python tools/tpu_lint.py --list-entrypoints
 
 Exit status: 0 when no unsuppressed findings, 1 otherwise.  Rules,
 suppression syntax (`# tpu-lint: disable=<rule> -- reason`) and the
-relationship to the runtime CEPH_TPU_VERIFY sanitizer are documented
-in docs/LINT.md.
+three-tier static→trace→runtime sanitizer story are documented in
+docs/LINT.md.
 
-The linter is pure stdlib-ast analysis: it never imports the scanned
-code, so it runs in any environment (no jax needed).
+The AST tier is pure stdlib-ast analysis: it never imports the scanned
+code, so it runs in any environment (no jax needed).  `--trace` runs
+the jaxpr audit over the entry-point registry
+(ceph_tpu/analysis/entrypoints.py): it imports jax and the library,
+traces every registered jit-facing entry point, walks the jaxprs
+against the audit-* rules, runs the recompile sentinel, and fails if
+any public plugin device surface is missing from the registry.
+`--check-suppressions` flags stale pragmas on either tier.
 """
 
 import argparse
@@ -28,37 +38,104 @@ from ceph_tpu.analysis import (LintConfig, lint_paths, render_human,
 from ceph_tpu.analysis.report import render_rules
 
 
+def _default_paths():
+    return [os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "ceph_tpu")]
+
+
+def _run_trace(args) -> int:
+    # imported here: the trace tier needs jax + the library; the AST
+    # tier must keep working without either
+    from ceph_tpu.analysis import (audit_registry, registry,
+                                   render_trace_human,
+                                   render_trace_json,
+                                   stale_trace_pragmas)
+
+    entries = list(registry())
+    if args.entry:
+        wanted = set(args.entry)
+        unknown = wanted - {e.name for e in entries}
+        if unknown:
+            print(f"unknown entry point(s): {sorted(unknown)} "
+                  f"(--list-entrypoints shows the registry)",
+                  file=sys.stderr)
+            return 2
+        entries = [e for e in entries if e.name in wanted]
+    report = audit_registry(
+        entries,
+        sentinel=not args.no_sentinel,
+        # completeness is a registry-wide property; a filtered run
+        # must not fail on entries it was asked to skip
+        completeness=not args.entry)
+    stale = []
+    if args.check_suppressions:
+        stale = stale_trace_pragmas(args.paths or _default_paths(),
+                                    report)
+    if args.json:
+        print(render_trace_json(report,
+                                show_stale=args.check_suppressions))
+    else:
+        print(render_trace_human(
+            report, show_suppressed=args.show_suppressed,
+            show_stale=args.check_suppressions))
+    return 0 if report.ok and not stale else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpu-lint",
-        description="AST static analysis for device purity, dtype and "
-                    "recompilation invariants")
+        description="AST + jaxpr-trace static analysis for device "
+                    "purity, dtype and recompilation invariants")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: ceph_tpu/)")
     ap.add_argument("--json", action="store_true",
                     help="JSON output")
     ap.add_argument("--list-rules", action="store_true",
-                    help="print every rule and exit")
+                    help="print every AST rule and exit")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings")
     ap.add_argument("--rule", action="append", default=None,
                     metavar="ID", help="run only these rule ids")
+    ap.add_argument("--check-suppressions", action="store_true",
+                    help="flag stale disable= pragmas that no longer "
+                         "suppress any finding")
+    ap.add_argument("--trace", action="store_true",
+                    help="run the jaxpr trace tier over the entry-point "
+                         "registry (imports jax)")
+    ap.add_argument("--entry", action="append", default=None,
+                    metavar="NAME",
+                    help="with --trace: audit only these entry points")
+    ap.add_argument("--no-sentinel", action="store_true",
+                    help="with --trace: skip the recompile sentinel "
+                         "(trace rules only; faster)")
+    ap.add_argument("--list-entrypoints", action="store_true",
+                    help="print the trace-tier entry-point registry "
+                         "and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         print(render_rules())
         return 0
+    if args.list_entrypoints:
+        from ceph_tpu.analysis import registry
+        for e in registry():
+            print(f"{e.name}  [{e.family}/{e.kind}] "
+                  f"trace_budget={e.trace_budget}")
+        return 0
+    if args.trace:
+        return _run_trace(args)
 
-    paths = args.paths or [os.path.join(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), "ceph_tpu")]
+    paths = args.paths or _default_paths()
     config = LintConfig(
         enabled_rules=frozenset(args.rule) if args.rule else None)
     report = lint_paths(paths, config)
     if args.json:
         print(render_json(report))
     else:
-        print(render_human(report, show_suppressed=args.show_suppressed))
-    return 0 if report.ok else 1
+        print(render_human(report, show_suppressed=args.show_suppressed,
+                           show_stale=args.check_suppressions))
+    ok = report.ok and not (args.check_suppressions and report.stale)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
