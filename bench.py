@@ -54,7 +54,14 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # competing for the headline max — a harness-accounting step-up must
 # never read as a kernel win.  Rows before this marker (r01–r05) are
 # implicitly version 1.
-METRIC_VERSION = 2
+# v3 (ISSUE 6, telemetry): every decode/degraded row becomes
+# {gbps, lat_p50_ms, lat_p99_ms, lat_p999_ms, lat_samples} instead of
+# a bare GB/s float (per-stripe-batch latency histograms from the
+# benchmark loops — the tail-latency axis ROADMAP item 3 serves), the
+# headline carries the same lat_* fields for its winning candidate,
+# and a compact `telemetry` blob (counters + histogram quantiles +
+# span-root count; full dump via tools/perf_dump.py) rides every line.
+METRIC_VERSION = 3
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -132,8 +139,50 @@ DEGRADED_ROWS = [
 ]
 
 
+def _row_result(res: dict, digits: int = 4) -> dict:
+    """metric_version 3 row shape: GB/s plus the per-stripe-batch
+    latency percentiles the workload's histogram recorded."""
+    row = {"gbps": round(res["gbps"], digits)}
+    for f in ("lat_p50_ms", "lat_p99_ms", "lat_p999_ms"):
+        row[f] = (round(res[f], 4) if res.get(f) is not None else None)
+    row["lat_samples"] = res.get("lat_samples")
+    return row
+
+
+def _telemetry_blob() -> dict:
+    """Compact unified-metrics summary for the one-line artifact:
+    counters/gauges verbatim, histograms collapsed to
+    count + p50/p99/p999, spans to root/dropped counts.  The full
+    dump (buckets, events, span trees) is tools/perf_dump.py's job —
+    the bench line must stay one line."""
+    try:
+        from ceph_tpu import telemetry
+        dump = telemetry.dump_all()
+    except Exception as e:  # noqa: BLE001 — metadata never kills bench
+        return {"error": f"{type(e).__name__}: {e}"}
+    out: dict = {"schema_version": dump.get("schema_version")}
+    for section, body in dump.items():
+        if section in ("schema_version", "spans"):
+            continue
+        compact = {}
+        for key, v in body.items():
+            if key == "__events__":
+                compact["events"] = len(v)
+            elif isinstance(v, dict) and "buckets" in v:
+                compact[key] = {k: v[k] for k in
+                                ("count", "p50", "p99", "p999")}
+            else:
+                compact[key] = v
+        out[section] = compact
+    spans = dump.get("spans", {})
+    out["spans"] = {"roots": len(spans.get("spans", ())),
+                    "dropped": spans.get("dropped", 0)}
+    return out
+
+
 def _degraded_rows(iterations: int, host_only: bool = False) -> dict:
-    """name -> GB/s (None on failure) for the recovery-path rows.
+    """name -> {gbps, lat_*} (None on failure) for the recovery-path
+    rows (metric_version 3 row shape).
 
     ``host_only`` (the tunnel-down error path): re-pin every row to
     --device host (argparse last-wins), so the repair-batched row's
@@ -145,7 +194,7 @@ def _degraded_rows(iterations: int, host_only: bool = False) -> dict:
         if host_only:
             argv += ["--device", "host"]
         try:
-            rows[name] = round(_run(argv)["gbps"], 4)
+            rows[name] = _row_result(_run(argv))
         except Exception as e:  # noqa: BLE001 - recorded, never fatal
             rows[name] = None
             print(f"degraded/{name}: {type(e).__name__}: {e}",
@@ -238,6 +287,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "host_gbps": round(host_gbps, 3),
         "degraded_rows": _degraded_rows(iterations=1, host_only=True),
         "last_good": _read_last_good(),
+        "telemetry": _telemetry_blob(),
         **_audit_meta(),
     }
 
@@ -287,6 +337,13 @@ def _device_reachable(timeout: int | None = None) -> bool:
 
 
 def main() -> int:
+    # jax.monitoring compile events → the telemetry registry, so the
+    # line's telemetry blob records how many programs this run built
+    try:
+        from ceph_tpu.telemetry import install_compile_monitor
+        install_compile_monitor()
+    except Exception:  # noqa: BLE001 — observability never kills bench
+        pass
     # Probe the device FIRST: under a wedged tunnel the whole run must
     # fail fast to the error line (VERDICT r04 weak#6 — the old order
     # spent ~3 min on host+cpp baselines before the probe, so an
@@ -356,7 +413,7 @@ def main() -> int:
     decode_rows = {}
     for name, argv in DECODE_ROWS:
         try:
-            decode_rows[name] = round(_run(argv)["gbps"], 3)
+            decode_rows[name] = _row_result(_run(argv), digits=3)
         except (Exception, SystemExit) as e:  # noqa: BLE001
             errors.append(f"decode/{name}: {type(e).__name__}: {e}")
             decode_rows[name] = None
@@ -392,11 +449,15 @@ def main() -> int:
             default=None),
         "slice_gbps": slice_gbps,
         "percall_gbps": round(percall["gbps"], 3) if percall else None,
-        "decode_gbps": decode_rows.get("rs_k8_m3_e2"),
+        "decode_gbps": (decode_rows.get("rs_k8_m3_e2") or {}).get("gbps"),
         "decode_rows": decode_rows,
         "degraded_rows": _degraded_rows(iterations=3),
+        "lat_p50_ms": best.get("lat_p50_ms"),
+        "lat_p99_ms": best.get("lat_p99_ms"),
+        "lat_p999_ms": best.get("lat_p999_ms"),
         "vs_host_groundtruth": round(best["gbps"] / host["gbps"], 3)
         if host["gbps"] > 0 else None,
+        "telemetry": _telemetry_blob(),
         **_audit_meta(),
     }
     _write_last_good(out)

@@ -44,9 +44,10 @@ import dataclasses
 from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
 
 # the five plugin families + the engine/ops/crush/scrub surfaces the
-# acceptance gate requires coverage for
+# acceptance gate requires coverage for, plus the telemetry plane
+# (host-tier: its whole contract is "compiles nothing, ever")
 FAMILIES = ("jerasure", "isa", "shec", "lrc", "clay",
-            "engine", "ops", "crush", "scrub")
+            "engine", "ops", "crush", "scrub", "telemetry")
 
 # public device surfaces a plugin family can expose; the completeness
 # check requires every one present on a family's representative
@@ -348,6 +349,17 @@ def _build_crc_batch() -> Built:
     return Built(ceph_crc32c_batch, (crcs, bufs), ceph_crc32c_batch)
 
 
+def _build_telemetry() -> Built:
+    """The telemetry plane as a host-tier entry: spans + histograms +
+    registry + both exporters run end to end (telemetry_selftest) and
+    must trigger ZERO jax compiles and return zero device arrays —
+    the recompile sentinel is the enforcement that instrumentation
+    can never leak into (or pull work onto) the device."""
+    from ..telemetry import telemetry_selftest
+
+    return Built(telemetry_selftest, (), telemetry_selftest)
+
+
 # ----------------------------------------------------------------------
 # THE registry
 
@@ -408,6 +420,8 @@ def registry() -> Tuple[EntryPoint, ...]:
                    trace_budget=24),
         EntryPoint("scrub.ceph_crc32c_batch", "scrub", "host",
                    _build_crc_batch, allow=None, trace_budget=0),
+        EntryPoint("telemetry.selftest", "telemetry", "host",
+                   _build_telemetry, allow=None, trace_budget=0),
     ]
     return tuple(entries)
 

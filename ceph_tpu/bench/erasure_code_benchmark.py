@@ -36,6 +36,28 @@ from typing import Dict, List
 import numpy as np
 
 from ..codes.registry import ErasureCodePluginRegistry
+from ..telemetry import LatencyHistogram
+
+
+class _LatTimer:
+    """Per-call latency recorder for the timed benchmark loops: wraps
+    each timed call in a perf_counter pair feeding a log-bucketed
+    histogram, so every workload row reports p50/p99/p999 alongside
+    its GB/s (metric_version 3).  One sample = one timed call — a
+    stripe-batch for the iteration loops, the whole chained dispatch
+    for --loop mode (which is a single device call by design)."""
+
+    def __init__(self) -> None:
+        self.hist = LatencyHistogram()
+
+    def run(self, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        self.hist.record(time.perf_counter() - t0)
+        return out
+
+    def record(self, seconds: float) -> None:
+        self.hist.record(seconds)
 
 
 def _parse_parameters(params: List[str]) -> Dict[str, str]:
@@ -280,12 +302,13 @@ class ErasureCodeBench:
         ec = self._instance()
         data = self._make_batch(ec)
         in_bytes_per_iter = data.nbytes  # batch * k * chunk_size
+        lat = _LatTimer()
 
         if a.device == "host":
             ec.encode_chunks_batch(data)  # warm caches
             begin = time.perf_counter()
             for _ in range(a.iterations):
-                ec.encode_chunks_batch(data)
+                lat.run(lambda: ec.encode_chunks_batch(data))
             elapsed = time.perf_counter() - begin
         else:
             # NB: on tunneled devices block_until_ready can return before
@@ -334,15 +357,19 @@ class ErasureCodeBench:
                 out = chained(slabs)
                 np.asarray(out.ravel()[:4])  # completion barrier
                 elapsed = time.perf_counter() - begin
+                lat.record(elapsed)  # --loop is ONE chained dispatch
                 total_bytes = in_bytes_per_iter * n_slabs * reps
-                return self._result("encode", elapsed, total_bytes)
+                return self._result("encode", elapsed, total_bytes, lat)
             if a.resident:
                 dev_data = jax.device_put(data)
                 out = ec.encode_chunks_jax(dev_data)  # compile/warmup
                 np.asarray(out[0, 0, :4])
                 begin = time.perf_counter()
                 for _ in range(a.iterations):
-                    out = ec.encode_chunks_jax(dev_data)
+                    # per-iteration samples are ENQUEUE latency here
+                    # (the completion barrier is one fetch at the end)
+                    out = lat.run(
+                        lambda: ec.encode_chunks_jax(dev_data))
                 np.asarray(out[0, 0, :4])  # completion barrier
                 elapsed = time.perf_counter() - begin
             else:
@@ -352,10 +379,10 @@ class ErasureCodeBench:
                 run()  # compile/warmup outside the timed loop
                 begin = time.perf_counter()
                 for _ in range(a.iterations):
-                    run()
+                    lat.run(run)
                 elapsed = time.perf_counter() - begin
         total_bytes = in_bytes_per_iter * a.iterations
-        return self._result("encode", elapsed, total_bytes)
+        return self._result("encode", elapsed, total_bytes, lat)
 
     # -- decode (ceph_erasure_code_benchmark.cc -> decode()) ---------------
 
@@ -427,6 +454,7 @@ class ErasureCodeBench:
         parity = np.asarray(ec.encode_chunks_batch(data))
         allchunks = self._place_chunks(ec, data, parity)
         patterns = self._erasure_patterns(ec, n)
+        lat = _LatTimer()
 
         if a.device == "jax" and a.loop:
             # device decode throughput: N chained decodes of one fixed
@@ -478,8 +506,9 @@ class ErasureCodeBench:
             out = chained(slabs)
             np.asarray(out.ravel()[:4])
             elapsed = time.perf_counter() - begin
+            lat.record(elapsed)  # --loop is ONE chained dispatch
             total_bytes = data.nbytes * n_slabs * reps
-            return self._result("decode", elapsed, total_bytes)
+            return self._result("decode", elapsed, total_bytes, lat)
         if a.device == "jax":
             import jax
             dev = jax.device_put(allchunks)
@@ -492,8 +521,10 @@ class ErasureCodeBench:
             begin = time.perf_counter()
             for pat in patterns:
                 available = tuple(i for i in range(n) if i not in pat)
-                out = ec.decode_chunks_jax(dev[:, np.array(available), :],
-                                           available, pat)
+                # per-pattern samples are enqueue latency (one fetch
+                # barrier at the end)
+                out = lat.run(lambda: ec.decode_chunks_jax(
+                    dev[:, np.array(available), :], available, pat))
             np.asarray(out[0, 0, :4])  # completion barrier
             elapsed = time.perf_counter() - begin
         else:
@@ -506,16 +537,18 @@ class ErasureCodeBench:
             for pat in patterns:
                 available = tuple(i for i in range(n) if i not in pat)
                 survivors = np.ascontiguousarray(allchunks[:, available, :])
-                ec.decode_chunks_batch(survivors, available, pat)
+                lat.run(lambda: ec.decode_chunks_batch(
+                    survivors, available, pat))
             elapsed = time.perf_counter() - begin
         total_bytes = data.nbytes * a.iterations
-        return self._result("decode", elapsed, total_bytes)
+        return self._result("decode", elapsed, total_bytes, lat)
 
     # -- output -------------------------------------------------------------
 
-    def _result(self, workload: str, elapsed: float, total_bytes: int) -> dict:
+    def _result(self, workload: str, elapsed: float, total_bytes: int,
+                lat: "_LatTimer | None" = None) -> dict:
         gbps = total_bytes / elapsed / 1e9 if elapsed > 0 else float("inf")
-        return {
+        res = {
             "workload": workload,
             "plugin": self.args.plugin,
             "profile": dict(self.profile),
@@ -530,6 +563,13 @@ class ErasureCodeBench:
             "loop": getattr(self.args, "loop", 0),
             "gbps": gbps,
         }
+        if lat is not None and lat.hist.count:
+            pcts = lat.hist.percentiles()
+            res["lat_p50_ms"] = pcts["p50"] * 1e3
+            res["lat_p99_ms"] = pcts["p99"] * 1e3
+            res["lat_p999_ms"] = pcts["p999"] * 1e3
+            res["lat_samples"] = lat.hist.count
+        return res
 
     def run(self) -> dict:
         from ..utils.perf import global_perf, profile_trace
@@ -598,11 +638,13 @@ class ErasureCodeBench:
         for it in range(a.iterations):
             repair(sinfo, ec, make_store(it), hinfo)
         stores = [make_store(it) for it in range(a.iterations)]
+        lat = _LatTimer()
         begin = time.perf_counter()
         for store in stores:
-            repair(sinfo, ec, store, hinfo)
+            lat.run(lambda: repair(sinfo, ec, store, hinfo))
         elapsed = time.perf_counter() - begin
-        res = self._result("degraded", elapsed, len(obj) * a.iterations)
+        res = self._result("degraded", elapsed, len(obj) * a.iterations,
+                           lat)
         res["erasures"] = a.erasures
         res["corruptions"] = a.corruptions
         return res
@@ -680,13 +722,15 @@ class ErasureCodeBench:
         # warm pattern caches + jit traces outside the timer
         repair_batched(sinfo, ec, make_stores(), hinfos, device=dev)
         runs = [make_stores() for _ in range(a.iterations)]
+        lat = _LatTimer()
         begin = time.perf_counter()
         rep = None
         for stores in runs:
-            rep = repair_batched(sinfo, ec, stores, hinfos, device=dev)
+            rep = lat.run(lambda: repair_batched(sinfo, ec, stores,
+                                                 hinfos, device=dev))
         elapsed = time.perf_counter() - begin
         res = self._result("repair-batched", elapsed,
-                           width * a.batch * a.iterations)
+                           width * a.batch * a.iterations, lat)
         res["erasures"] = a.erasures
         res["corruptions"] = a.corruptions
         res["pattern_batches"] = rep.pattern_batches
@@ -796,13 +840,14 @@ class ErasureCodeBench:
             return rep
 
         run_once(1000)                      # warm caches + jit traces
+        lat = _LatTimer()
         begin = time.perf_counter()
         rep = None
         for it in range(a.iterations):
-            rep = run_once(it)
+            rep = lat.run(lambda: run_once(it))
         elapsed = time.perf_counter() - begin
         res = self._result("recovery-churn", elapsed,
-                           width * a.batch * a.iterations)
+                           width * a.batch * a.iterations, lat)
         res["erasures"] = a.erasures
         res["corruptions"] = a.corruptions
         res["churn_every"] = a.churn_every

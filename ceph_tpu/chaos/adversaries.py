@@ -65,6 +65,9 @@ class CrashPoint:
             return
         if self.hits[site] >= self.at_hit:
             self.fired = True
+            from ..telemetry import metrics as tel
+            tel.counter("chaos_injections", kind="crash")
+            tel.event("injected_crash", site=site, hit=self.hits[site])
             raise InjectedCrash(site, self.hits[site])
 
 
@@ -135,6 +138,8 @@ class MapChurn:
                             "epoch": inc.epoch,
                             "detail": self._detail(kind, payload)})
         self.incrementals.append(inc)
+        from ..telemetry import metrics as tel
+        tel.counter("chaos_injections", kind=f"churn_{kind}")
         return inc
 
     @staticmethod

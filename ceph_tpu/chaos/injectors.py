@@ -263,6 +263,10 @@ def inject(shards_or_store, injectors: Sequence[Injector], seed: int,
     store = ensure_store(shards_or_store, chunk_size=chunk_size)
     rng = np.random.default_rng(seed)
     faults = Compose(tuple(injectors)).apply(store, rng)
+    if faults:
+        from ..telemetry import metrics as tel
+        for f in faults:
+            tel.counter("chaos_injections", kind=f.kind)
     return store, faults
 
 

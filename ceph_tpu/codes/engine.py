@@ -40,6 +40,7 @@ import threading
 from collections import OrderedDict
 from typing import Callable, Optional, Tuple
 
+from ..telemetry import metrics as tel
 from ..utils.log import dout
 
 DEFAULT_MAX_PATTERNS = 512
@@ -72,18 +73,26 @@ class PatternCache:
             if hit is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
+                tel.counter("pattern_cache_hits")
                 return hit
         # build OUTSIDE the lock: clay's impulse probe can take
         # seconds and must not serialize unrelated patterns
-        value = builder()
+        with tel.record_dispatch("pattern_cache_build"):
+            value = builder()
         with self._lock:
             race = self._entries.get(key)
             if race is not None:
                 self.hits += 1
+                tel.counter("pattern_cache_hits")
                 return race
             self.builds += 1
+            tel.counter("pattern_cache_builds")
             if (self.recompile_budget is not None
                     and self.builds > self.recompile_budget):
+                tel.counter("pattern_cache_budget_exceeded")
+                tel.event("pattern_cache_budget_exceeded",
+                          builds=self.builds,
+                          budget=self.recompile_budget)
                 raise RuntimeError(
                     f"pattern-cache recompile budget exceeded: "
                     f"{self.builds} composite builds > "
@@ -93,6 +102,7 @@ class PatternCache:
             while len(self._entries) > self.max_patterns:
                 self._entries.popitem(last=False)
                 self.evictions += 1
+                tel.counter("pattern_cache_evictions")
                 if not self._warned:
                     self._warned = True
                     dout("ec", 1,
@@ -193,13 +203,31 @@ def fused_repair_call(ec, available: Tuple[int, ...],
 
         @jax.jit
         def fn(stack):
-            rec = ec.decode_chunks_jax(stack, available, erased)
+            # named_scope is pure trace metadata (no primitives — the
+            # jaxpr audit stays byte-identical); it labels the decode
+            # and re-encode regions in TensorBoard device traces so
+            # they line up with the host "dispatch" span around the
+            # call
+            with jax.named_scope("fused_repair.decode"):
+                rec = ec.decode_chunks_jax(stack, available, erased)
             cols = [stack[:, t, :] if where == "avail" else rec[:, t, :]
                     for where, t in src]
             data = jnp.stack(cols, axis=1)
-            parity = ec.encode_chunks_jax(data)
+            with jax.named_scope("fused_repair.reencode"):
+                parity = ec.encode_chunks_jax(data)
             return rec, parity
 
-        return fn
+        def timed(stack):
+            # host-side dispatch latency histogram.  Tracer inputs
+            # mean WE are being traced into a larger program — record
+            # nothing (a trace-time clock read is fiction) and leave
+            # the jaxpr telemetry-free by construction.
+            with tel.record_dispatch(
+                    "engine_fused_repair_dispatch",
+                    eager=not isinstance(stack, jax.core.Tracer),
+                    plugin=type(ec).__name__):
+                return fn(stack)
+
+        return timed
 
     return global_pattern_cache().get_or_build(key, build)

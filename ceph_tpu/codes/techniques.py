@@ -66,15 +66,19 @@ class MatrixCodeMixin:
 
     def _apply(self, chunks: np.ndarray, matrix: np.ndarray,
                matrix_static) -> np.ndarray:
+        from ..telemetry.metrics import record_dispatch
         perf = global_perf()
         words = regionops.words_view(np.ascontiguousarray(chunks), self.w)
         if chunks.nbytes < self.min_xla_bytes or _numpy_tier():
             perf.inc("ec_host_calls")
             perf.inc("ec_host_bytes", chunks.nbytes)
-            return regionops.matrix_encode(words, matrix, self.w).view(np.uint8)
+            with record_dispatch("ec_apply", path="host"):
+                return regionops.matrix_encode(
+                    words, matrix, self.w).view(np.uint8)
         perf.inc("ec_device_calls")
         perf.inc("ec_device_bytes", chunks.nbytes)
-        with perf.timed("ec_device_time"):
+        with perf.timed("ec_device_time"), \
+                record_dispatch("ec_apply", path="device"):
             out = np.asarray(
                 apply_matrix_best(words, matrix_static, self.w)).view(np.uint8)
         if verification_enabled():
@@ -174,15 +178,18 @@ class BitmatrixCodeMixin:
 
     def _apply(self, chunks: np.ndarray, bitmatrix: np.ndarray,
                bitmatrix_static) -> np.ndarray:
+        from ..telemetry.metrics import record_dispatch
         perf = global_perf()
         if chunks.nbytes < self.min_xla_bytes or _numpy_tier():
             perf.inc("ec_host_calls")
             perf.inc("ec_host_bytes", chunks.nbytes)
-            return regionops.bitmatrix_encode(chunks, bitmatrix, self.w,
-                                              self.packetsize)
+            with record_dispatch("ec_apply", path="host"):
+                return regionops.bitmatrix_encode(
+                    chunks, bitmatrix, self.w, self.packetsize)
         perf.inc("ec_device_calls")
         perf.inc("ec_device_bytes", chunks.nbytes)
-        with perf.timed("ec_device_time"):
+        with perf.timed("ec_device_time"), \
+                record_dispatch("ec_apply", path="device"):
             out = np.asarray(apply_bitmatrix_best(
                 chunks, bitmatrix_static, self.w, self.packetsize))
         if verification_enabled():

@@ -108,6 +108,16 @@ class FallbackPolicy:
         tail = (f"; probe error: {type(self.probe_error).__name__}: "
                 f"{self.probe_error}" if self.probe_error else "")
         dout("ec", 1, f"backend fallback policy: engine={eng} ({why}){tail}")
+        # the log-once transition is ALSO a counter + structured event
+        # in the telemetry plane: a tier drop mid-fleet is a metric to
+        # alert on, not just a line someone may have had enabled
+        from ..telemetry import metrics as tel
+        tel.counter("fallback_tier_transitions", device=kind, engine=eng)
+        tel.event("fallback_tier", device=kind, engine=eng,
+                  forced=forced,
+                  probe_error=(f"{type(self.probe_error).__name__}: "
+                               f"{self.probe_error}"
+                               if self.probe_error else None))
 
 
 _global: Optional[FallbackPolicy] = None

@@ -390,16 +390,27 @@ def apply_matrix_packed_best(words: jax.Array, matrix_t) -> jax.Array:
     matrices, the generalized Pallas packed kernel otherwise on TPU;
     on other backends, bitcast to bytes and take the XLA path (CPU has
     no tiled layouts, so the casts are cheap there).  Byte-identical
-    in every branch."""
+    in every branch.
+
+    Eager calls (concrete array in — a real dispatch, not a trace)
+    record into the ``ops_apply_matrix_*`` telemetry histogram with
+    the chosen engine tier as a label; traced calls record nothing,
+    so jitted programs stay telemetry-free (docs/OBSERVABILITY.md)."""
     from . import xla_ops
+    from ..telemetry.metrics import record_dispatch
     eng = select_matrix_engine(words.shape, matrix_t, 8, packed=True)
-    if eng == "mxu":
-        out = xla_ops.apply_matrix_mxu(_packed_to_bytes(words), matrix_t)
+    with record_dispatch("ops_apply_matrix",
+                         eager=not isinstance(words, jax.core.Tracer),
+                         engine=eng, layout="packed"):
+        if eng == "mxu":
+            out = xla_ops.apply_matrix_mxu(_packed_to_bytes(words),
+                                           matrix_t)
+            return _bytes_to_packed(out)
+        if eng == "pallas":
+            return apply_matrix_pallas_packed(words, matrix_t)
+        out = xla_ops.apply_matrix_xla(_packed_to_bytes(words),
+                                       matrix_t, 8)
         return _bytes_to_packed(out)
-    if eng == "pallas":
-        return apply_matrix_pallas_packed(words, matrix_t)
-    out = xla_ops.apply_matrix_xla(_packed_to_bytes(words), matrix_t, 8)
-    return _bytes_to_packed(out)
 
 
 def _bitmatrix_kernel(rows_masks, s: int, w: int, r: int, rt: int):
@@ -569,19 +580,23 @@ def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
     """
     from . import xla_ops
     from .xla_ops import apply_matrix_xla
+    from ..telemetry.metrics import record_dispatch
     word_typed = ((w == 8 and chunks.dtype == jnp.uint8)
                   or (w in (16, 32) and chunks.dtype == _WORD_DTYPE.get(w)))
     eng = (select_matrix_engine(chunks.shape, matrix_t, w)
            if word_typed else "xla")
-    if eng == "mxu":
-        # module attribute (not a local import) so the routing test
-        # can observe which engine was selected
-        return xla_ops.apply_matrix_mxu(chunks, matrix_t)
-    if eng == "pallas":
-        if w == 8:
-            return apply_matrix_pallas(chunks, matrix_t)
-        return apply_matrix_pallas_words(chunks, matrix_t, w)
-    return apply_matrix_xla(chunks, matrix_t, w)
+    with record_dispatch("ops_apply_matrix",
+                         eager=not isinstance(chunks, jax.core.Tracer),
+                         engine=eng, layout="bytes"):
+        if eng == "mxu":
+            # module attribute (not a local import) so the routing test
+            # can observe which engine was selected
+            return xla_ops.apply_matrix_mxu(chunks, matrix_t)
+        if eng == "pallas":
+            if w == 8:
+                return apply_matrix_pallas(chunks, matrix_t)
+            return apply_matrix_pallas_words(chunks, matrix_t, w)
+        return apply_matrix_xla(chunks, matrix_t, w)
 
 
 def apply_bitmatrix_best(chunks: jax.Array, bitmatrix_rows, w: int,

@@ -44,6 +44,8 @@ from ..crush.incremental import get_epoch
 from ..crush.types import CRUSH_ITEM_NONE
 from ..scrub.deep_scrub import deep_scrub, repair_batched, \
     unrecoverable_extents
+from ..telemetry import metrics as tel
+from ..telemetry.spans import global_tracer
 from ..utils.errors import InjectedCrash
 from ..utils.log import dout
 from ..utils.retry import RetryPolicy, SystemClock
@@ -209,6 +211,10 @@ class RecoveryOrchestrator:
         self._obj_deadline: Dict[int, float] = {}
         self._unrecoverable: set = set()
         self._expired: set = set()
+        # first time each damaged object was planned (telemetry: the
+        # end-to-end recovery latency histogram measures from here to
+        # journal clear, throttle/fence deferral rounds included)
+        self._obj_first_planned: Dict[int, float] = {}
 
     # -- adversary hooks -------------------------------------------------
 
@@ -277,12 +283,14 @@ class RecoveryOrchestrator:
                 deadline = dl
             else:
                 deadline = None
+            self._obj_first_planned.setdefault(i, now)
             ops.append(RecoveryOp(
                 op_id=self.journal.allocate_op_id(), obj=i,
                 erased=tuple(rep.bad), available=tuple(rep.clean),
                 shard_length=rep.shard_length, epoch=epoch,
                 placement=acting, deadline=deadline))
         self.report.ops_planned += len(ops)
+        tel.counter("recovery_ops_planned", len(ops))
         return ops
 
     # -- stage 2: decode (batched, epoch-fenced by repair_batched) -------
@@ -305,6 +313,8 @@ class RecoveryOrchestrator:
         self.report.device_calls += batch.device_calls
         self.report.host_batches += batch.host_batches
         self.report.regroups += batch.regroups
+        if batch.regroups:
+            tel.counter("recovery_regroups", batch.regroups)
         return {obj: dict(batch.reports[t].repaired)
                 for t, obj in enumerate(objs)}
 
@@ -328,6 +338,7 @@ class RecoveryOrchestrator:
                 op.placement = self._acting()
                 op.epoch = cur
                 r.replans += 1
+                tel.counter("recovery_replans")
             payload = payloads.get(op.obj)
             if payload is None or set(payload) != set(op.erased):
                 # the decode round's (regrouped) classification no
@@ -341,6 +352,7 @@ class RecoveryOrchestrator:
                       or self.osdmap.is_out(o)]
             if fenced:
                 r.fence_deferrals += 1
+                tel.counter("recovery_fence_deferrals")
                 dout("ec", 5, f"recovery: op {op.op_id} fenced — "
                               f"shards {fenced} target down/out/"
                               f"unplaceable osds at epoch {cur}")
@@ -363,6 +375,14 @@ class RecoveryOrchestrator:
             self._crash("writeback.after_commit")
             self.journal.clear(op.op_id)
             r.ops_completed += 1
+            tel.counter("recovery_ops_completed")
+            # end-to-end op latency: first plan of this object →
+            # durable clear, every deferral/throttle/journal wait in
+            # between included (self.clock, so FakeClock tests pin it)
+            started = self._obj_first_planned.pop(
+                op.obj, self.clock.monotonic())
+            tel.observe("recovery_op_seconds",
+                        self.clock.monotonic() - started)
 
     def _verify_landed(self, op: RecoveryOp,
                        payload: Dict[int, bytes], store) -> bool:
@@ -392,26 +412,34 @@ class RecoveryOrchestrator:
         """One daemon lifetime: journal replay, then recovery rounds
         until converged (nothing actionable left) or max_rounds."""
         r = self.report
-        r.epoch_start = get_epoch(self.osdmap)
-        stats = self.journal.replay(self.stores)
-        r.journal_replays += 1
-        r.journal.merge(stats)
-        while True:
-            self._churn("plan")
-            ops = self._plan()
-            self._crash("plan.after_scrub")
-            if not ops:
-                r.converged = True
-                break
-            if r.rounds >= self.max_rounds:
-                break
-            r.rounds += 1
-            payloads = self._decode(ops)
-            self.throttle.reset_round()
-            self._writeback(ops, payloads)
-            if self.round_delay:
-                self.clock.sleep(self.round_delay)
-        r.epoch_end = get_epoch(self.osdmap)
+        tracer = global_tracer()
+        with tracer.span("recovery.run", objects=len(self.stores)):
+            r.epoch_start = get_epoch(self.osdmap)
+            with tracer.span("journal_replay"):
+                stats = self.journal.replay(self.stores)
+            r.journal_replays += 1
+            tel.counter("recovery_journal_replays")
+            r.journal.merge(stats)
+            while True:
+                self._churn("plan")
+                with tracer.span("plan"):
+                    ops = self._plan()
+                self._crash("plan.after_scrub")
+                if not ops:
+                    r.converged = True
+                    break
+                if r.rounds >= self.max_rounds:
+                    break
+                r.rounds += 1
+                with tracer.span("round", round=r.rounds):
+                    with tracer.span("decode", ops=len(ops)):
+                        payloads = self._decode(ops)
+                    self.throttle.reset_round()
+                    with tracer.span("writeback", ops=len(ops)):
+                        self._writeback(ops, payloads)
+                if self.round_delay:
+                    self.clock.sleep(self.round_delay)
+            r.epoch_end = get_epoch(self.osdmap)
         return r
 
 

@@ -31,15 +31,18 @@ class OsdRecoveryThrottle:
     peak: int = 0             # max per-osd admissions ever observed
 
     def admit(self, targets: Iterable[int]) -> bool:
+        from ..telemetry import metrics as tel
         osds = [int(o) for o in targets]
         if any(self.inflight.get(o, 0) >= self.max_inflight
                for o in osds):
             self.deferrals += 1
+            tel.counter("recovery_throttle_deferrals")
             return False
         for o in osds:
             self.inflight[o] = self.inflight.get(o, 0) + 1
             self.peak = max(self.peak, self.inflight[o])
         self.admitted += 1
+        tel.counter("recovery_throttle_admitted")
         return True
 
     def reset_round(self) -> None:

@@ -39,6 +39,8 @@ import numpy as np
 from ..chaos.store import ShardStore, ensure_store
 from ..codes import stripe as stripe_mod
 from ..codes.stripe import HashInfo, StripeInfo, ceph_crc32c_batch
+from ..telemetry import metrics as tel
+from ..telemetry.spans import global_tracer
 from ..utils.errors import (
     RetryExhausted,
     ScrubError,
@@ -163,6 +165,7 @@ def deep_scrub(sinfo: StripeInfo, ec, store, hinfo: HashInfo, *,
     can't crc-match a cumulative hash); everything else verifies
     against HashInfo in one ceph_crc32c_batch call across all shards.
     """
+    _t0 = tel.global_metrics().clock.monotonic()
     store = ensure_store(store, chunk_size=sinfo.chunk_size)
     n = ec.get_chunk_count()
     expected_len = hinfo.total_chunk_size
@@ -214,6 +217,8 @@ def deep_scrub(sinfo: StripeInfo, ec, store, hinfo: HashInfo, *,
     if report.bad:
         dout("ec", 5, f"deep_scrub: missing={report.missing} "
                       f"corrupt={report.corrupt}")
+    tel.observe("scrub_deep_scrub_seconds",
+                tel.global_metrics().clock.monotonic() - _t0)
     return report
 
 
@@ -395,14 +400,25 @@ def repair_batched(sinfo: StripeInfo, ec, stores, hinfos, *,
     reports: List[Optional[RepairReport]] = [None] * len(stores)
     scrubs: List[Optional[ScrubReport]] = [None] * len(stores)
 
+    tracer = global_tracer()
+
     def _plan(indices) -> Dict[tuple, List[int]]:
         """Scrub + classify + feasibility-check ``indices``; returns
         the (clean, erased, length) pattern grouping.  Re-run whole
         whenever the map epoch moves between plan and dispatch."""
+        indices = list(indices)
+        with tracer.span("scrub", objects=len(indices)):
+            scrubbed = {i: deep_scrub(sinfo, ec, stores[i], hinfos[i],
+                                      retry_policy=retry_policy,
+                                      clock=clock)
+                        for i in indices}
+        with tracer.span("plan"):
+            return _group(indices, scrubbed)
+
+    def _group(indices, scrubbed) -> Dict[tuple, List[int]]:
         groups: Dict[tuple, List[int]] = {}
         for i in indices:
-            rep = deep_scrub(sinfo, ec, stores[i], hinfos[i],
-                             retry_policy=retry_policy, clock=clock)
+            rep = scrubbed[i]
             scrubs[i] = rep
             if rep.is_clean:
                 reports[i] = RepairReport(scrub=rep,
@@ -439,8 +455,6 @@ def repair_batched(sinfo: StripeInfo, ec, stores, hinfos, *,
             groups.setdefault(key, []).append(i)
         return groups
 
-    plan_epoch = get_epoch(osdmap) if osdmap is not None else None
-    pending = list(_plan(range(len(stores))).items())
     perf = global_perf()
     device_calls = 0
     host_batches = 0
@@ -449,113 +463,144 @@ def repair_batched(sinfo: StripeInfo, ec, stores, hinfos, *,
     batch_index = 0
     gate_failures: List[str] = []
     call_hook = True
-    while pending:
-        (available, erased, shard_len), members = pending[0]
-        if call_hook and on_batch is not None:
-            on_batch(batch_index, (available, erased, shard_len))
-        call_hook = True
-        batch_index += 1
-        if osdmap is not None and get_epoch(osdmap) != plan_epoch:
-            # the map moved between plan and this dispatch: the stale
-            # grouping must not be dispatched — re-scrub everything
-            # still pending and re-group against the current epoch
-            # (the hook is NOT re-fired for the regrouped head, so one
-            # churn event costs at most one regroup, never a livelock)
-            remaining = sorted({i for _, ms in pending for i in ms})
-            plan_epoch = get_epoch(osdmap)
-            regroups += 1
-            pending = list(_plan(remaining).items())
-            call_hook = False
-            continue
-        pending.pop(0)
-        pattern_batches += 1
-        n_stripes = shard_len // sinfo.chunk_size
-        reads_by_obj: List[Dict[int, bytes]] = []
-        stacks = []
-        for i in members:
-            reads = {s: retry_call(stores[i].read, s,
-                                   policy=retry_policy, clock=clock)
-                     for s in available}
-            reads_by_obj.append(reads)
-            stacks.append(np.stack(
-                [np.frombuffer(reads[s], dtype=np.uint8).reshape(
-                    n_stripes, sinfo.chunk_size) for s in available],
-                axis=1))
-        stack = np.concatenate(stacks, axis=0)  # (B*stripes, na, C)
-        aidx = {s: t for t, s in enumerate(available)}
-        eidx = {s: t for t, s in enumerate(erased)}
-        use_device = device if device is not None else not _numpy_tier()
-        if not use_device:
-            # numpy tier: still grouped (one host pass per pattern),
-            # zero device traffic by policy
-            rec_arr = np.asarray(ec.decode_chunks_batch(
-                stack, available, erased))
-            cols = [stack[:, aidx[mapping[c]], :] if mapping[c] in aidx
-                    else rec_arr[:, eidx[mapping[c]], :]
-                    for c in range(k)]
-            parity = np.asarray(ec.encode_chunks_batch(
-                np.ascontiguousarray(np.stack(cols, axis=1))))
-            host_batches += 1
-            perf.inc("scrub_batch_host_calls")
-        else:
-            import jax
-            fn = fused_repair_call(ec, available, erased)
-            rec_dev, par_dev = fn(jax.device_put(stack))
-            rec_arr = np.asarray(rec_dev)
-            parity = np.asarray(par_dev)
-            device_calls += 1
-            perf.inc("scrub_batch_device_calls")
-        perf.inc("scrub_batch_stripes", stack.shape[0])
+    with tracer.span("repair", objects=len(stores),
+                     plugin=type(ec).__name__):
+        plan_epoch = get_epoch(osdmap) if osdmap is not None else None
+        pending = list(_plan(range(len(stores))).items())
+        while pending:
+            (available, erased, shard_len), members = pending[0]
+            if call_hook and on_batch is not None:
+                on_batch(batch_index, (available, erased, shard_len))
+            call_hook = True
+            batch_index += 1
+            if osdmap is not None and get_epoch(osdmap) != plan_epoch:
+                # the map moved between plan and this dispatch: the
+                # stale grouping must not be dispatched — re-scrub
+                # everything still pending and re-group against the
+                # current epoch (the hook is NOT re-fired for the
+                # regrouped head, so one churn event costs at most one
+                # regroup, never a livelock)
+                remaining = sorted({i for _, ms in pending for i in ms})
+                plan_epoch = get_epoch(osdmap)
+                regroups += 1
+                tel.counter("repair_regroups")
+                pending = list(_plan(remaining).items())
+                call_hook = False
+                continue
+            pending.pop(0)
+            pattern_batches += 1
+            tel.counter("repair_pattern_batches")
+            n_stripes = shard_len // sinfo.chunk_size
+            use_device = (device if device is not None
+                          else not _numpy_tier())
+            engine_label = "device" if use_device else "host"
+            with tracer.span("dispatch", batch=batch_index - 1,
+                             engine=engine_label,
+                             members=len(members)):
+                reads_by_obj: List[Dict[int, bytes]] = []
+                stacks = []
+                for i in members:
+                    reads = {s: retry_call(stores[i].read, s,
+                                           policy=retry_policy,
+                                           clock=clock)
+                             for s in available}
+                    reads_by_obj.append(reads)
+                    stacks.append(np.stack(
+                        [np.frombuffer(reads[s], dtype=np.uint8).reshape(
+                            n_stripes, sinfo.chunk_size)
+                         for s in available],
+                        axis=1))
+                stack = np.concatenate(stacks, axis=0)  # (B*str, na, C)
+                aidx = {s: t for t, s in enumerate(available)}
+                eidx = {s: t for t, s in enumerate(erased)}
+                with tel.record_dispatch("scrub_dispatch",
+                                         engine=engine_label):
+                    if not use_device:
+                        # numpy tier: still grouped (one host pass per
+                        # pattern), zero device traffic by policy
+                        rec_arr = np.asarray(ec.decode_chunks_batch(
+                            stack, available, erased))
+                        cols = [stack[:, aidx[mapping[c]], :]
+                                if mapping[c] in aidx
+                                else rec_arr[:, eidx[mapping[c]], :]
+                                for c in range(k)]
+                        parity = np.asarray(ec.encode_chunks_batch(
+                            np.ascontiguousarray(
+                                np.stack(cols, axis=1))))
+                        host_batches += 1
+                        perf.inc("scrub_batch_host_calls")
+                    else:
+                        import jax
+                        fn = fused_repair_call(ec, available, erased)
+                        rec_dev, par_dev = fn(jax.device_put(stack))
+                        rec_arr = np.asarray(rec_dev)
+                        parity = np.asarray(par_dev)
+                        device_calls += 1
+                        perf.inc("scrub_batch_device_calls")
+                perf.inc("scrub_batch_stripes", stack.shape[0])
 
-        for t, i in enumerate(members):
-            lo = t * n_stripes
-            rec = {s: np.ascontiguousarray(
-                rec_arr[lo:lo + n_stripes, eidx[s], :]).tobytes()
-                for s in erased}
-            current: Dict[int, bytes] = {}
-            for s in range(n):
-                if s in rec:
-                    current[s] = rec[s]
-                elif s in aidx:
-                    current[s] = reads_by_obj[t][s]
-                else:
-                    current[s] = retry_call(stores[i].read, s,
-                                            policy=retry_policy,
-                                            clock=clock)
-            # re-encode gate: fused parity vs surviving/recovered
-            # shards (data shards are assembled FROM current, so the
-            # byte-identity obligation reduces to the parity rows —
-            # exactly what the per-object gate checks effectively)
-            mismatch = []
-            for j in range(ec.get_coding_chunk_count()):
-                s = mapping[k + j]
-                expect = np.ascontiguousarray(
-                    parity[lo:lo + n_stripes, j, :]).tobytes()
-                if expect != current[s]:
-                    mismatch.append(s)
-            if mismatch:
-                gate_failures.append(
-                    f"object {i}: re-encode mismatch on shards "
-                    f"{mismatch}")
-                reports[i] = RepairReport(scrub=scrubs[i])
-                continue
-            crcs = ceph_crc32c_batch(
-                [CRC_SEED] * n,
-                np.stack([np.frombuffer(current[s], dtype=np.uint8)
-                          for s in range(n)]))
-            crc_bad = [s for s in range(n)
-                       if int(crcs[s]) != hinfos[i].get_chunk_hash(s)]
-            if crc_bad:
-                gate_failures.append(
-                    f"object {i}: crc gate failed on shards {crc_bad}")
-                reports[i] = RepairReport(scrub=scrubs[i])
-                continue
-            if write_back:
-                for s in erased:
-                    stores[i].write(s, rec[s])
-            reports[i] = RepairReport(scrub=scrubs[i], repaired=rec,
-                                      reencode_verified=True,
-                                      crc_verified=True)
+            to_write: List[Tuple[int, Dict[int, bytes]]] = []
+            with tracer.span("verify", members=len(members)):
+                for t, i in enumerate(members):
+                    lo = t * n_stripes
+                    rec = {s: np.ascontiguousarray(
+                        rec_arr[lo:lo + n_stripes, eidx[s], :]).tobytes()
+                        for s in erased}
+                    current: Dict[int, bytes] = {}
+                    for s in range(n):
+                        if s in rec:
+                            current[s] = rec[s]
+                        elif s in aidx:
+                            current[s] = reads_by_obj[t][s]
+                        else:
+                            current[s] = retry_call(
+                                stores[i].read, s,
+                                policy=retry_policy, clock=clock)
+                    # re-encode gate: fused parity vs surviving/
+                    # recovered shards (data shards are assembled FROM
+                    # current, so the byte-identity obligation reduces
+                    # to the parity rows — exactly what the per-object
+                    # gate checks effectively)
+                    mismatch = []
+                    for j in range(ec.get_coding_chunk_count()):
+                        s = mapping[k + j]
+                        expect = np.ascontiguousarray(
+                            parity[lo:lo + n_stripes, j, :]).tobytes()
+                        if expect != current[s]:
+                            mismatch.append(s)
+                    if mismatch:
+                        gate_failures.append(
+                            f"object {i}: re-encode mismatch on shards "
+                            f"{mismatch}")
+                        reports[i] = RepairReport(scrub=scrubs[i])
+                        tel.counter("repair_gate_failures",
+                                    gate="reencode")
+                        continue
+                    crcs = ceph_crc32c_batch(
+                        [CRC_SEED] * n,
+                        np.stack([np.frombuffer(current[s],
+                                                dtype=np.uint8)
+                                  for s in range(n)]))
+                    crc_bad = [s for s in range(n)
+                               if int(crcs[s])
+                               != hinfos[i].get_chunk_hash(s)]
+                    if crc_bad:
+                        gate_failures.append(
+                            f"object {i}: crc gate failed on shards "
+                            f"{crc_bad}")
+                        reports[i] = RepairReport(scrub=scrubs[i])
+                        tel.counter("repair_gate_failures", gate="crc")
+                        continue
+                    to_write.append((i, rec))
+                    reports[i] = RepairReport(scrub=scrubs[i],
+                                              repaired=rec,
+                                              reencode_verified=True,
+                                              crc_verified=True)
+            if write_back and to_write:
+                with tracer.span("write_back", members=len(to_write)):
+                    for i, rec in to_write:
+                        for s in sorted(rec):
+                            stores[i].write(s, rec[s])
     if pattern_batches:
         dout("ec", 5, f"repair_batched: {len(stores)} objects, "
                       f"{pattern_batches} pattern batches, "

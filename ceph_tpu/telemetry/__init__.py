@@ -1,0 +1,147 @@
+"""ceph_tpu.telemetry — span tracing, latency histograms and the
+unified metrics plane (docs/OBSERVABILITY.md).
+
+The observability layer the serving/recovery roadmap items lean on:
+
+- ``spans``     — deterministic, clock-injectable span trees over the
+                  host pipeline phases (repair → scrub/plan/dispatch/
+                  verify/write_back), mirrored to
+                  jax.profiler.TraceAnnotation when jax is loaded so
+                  TensorBoard device traces line up with host spans.
+- ``histogram`` — log-bucketed HDR-style latency histograms with
+                  exact p50/p99/p999 readout.
+- ``metrics``   — THE labeled counter/gauge/histogram/event registry
+                  every scattered ad-hoc counter folds into; dumps in
+                  the `perf dump` JSON shape and Prometheus text.
+- ``schema``    — shape validation for the unified dump (the
+                  tools/test_full.sh telemetry gate).
+
+Host-side only **by construction**: this package never imports jax at
+module scope and never compiles anything — enforced forever by the
+``telemetry.selftest`` host-tier entry in analysis/entrypoints.py
+(the jaxpr-audit recompile sentinel fails if the representative
+workload below triggers one backend compile or returns one device
+array).
+"""
+
+from __future__ import annotations
+
+from .histogram import LatencyHistogram, bucket_index, bucket_lower
+from .metrics import (
+    MetricsRegistry,
+    counter,
+    event,
+    gauge,
+    global_metrics,
+    install_compile_monitor,
+    observe,
+    record_dispatch,
+    set_enabled,
+    set_global_metrics,
+)
+from .schema import SCHEMA_VERSION, validate_dump
+from .spans import (
+    Span,
+    SpanTracer,
+    global_tracer,
+    set_global_tracer,
+    span,
+)
+
+
+def dump_all() -> dict:
+    """The unified observability dump: the legacy perf-counter
+    registry (utils/perf.py, the reference's `perf dump` shape), the
+    telemetry metrics registry, and the finished span trees — one
+    JSON object, validated by schema.validate_dump."""
+    from ..utils.perf import global_perf
+
+    out: dict = {"schema_version": SCHEMA_VERSION}
+    out.update(global_perf().dump())
+    out.update(global_metrics().dump())
+    out["spans"] = global_tracer().to_dict()
+    return out
+
+
+def reset_all() -> None:
+    """Reset every process-global observability surface (tests and
+    the perf-dump CLI's fresh-scenario runs)."""
+    from ..utils.perf import global_perf
+
+    global_perf().reset()
+    global_metrics().reset()
+    global_tracer().reset()
+
+
+def telemetry_selftest() -> dict:
+    """The tpu-audit host-tier representative workload: drive a span
+    tree, a histogram, labeled counters and both exporters on
+    ISOLATED instances with a fixed fake clock, validate the combined
+    shape, and return plain host data.  Registered in
+    analysis/entrypoints.py with ``kind="host"`` — if this ever
+    compiles a jax program or returns a device array, the recompile
+    sentinel turns red and the host/device boundary violation cannot
+    ship."""
+
+    class _Tick:
+        def __init__(self) -> None:
+            self.now = 0.0
+
+        def monotonic(self) -> float:
+            self.now += 0.001
+            return self.now
+
+    clock = _Tick()
+    tracer = SpanTracer(clock=clock, annotate=False)
+    registry = MetricsRegistry(clock=clock)
+    with tracer.span("repair", objects=2):
+        with tracer.span("scrub"):
+            registry.counter("selftest_scrubs", 2)
+        with tracer.span("dispatch", engine="host"):
+            with registry.timed("selftest_dispatch_seconds",
+                                engine="host"):
+                pass
+    registry.observe("selftest_dispatch_seconds", 0.002, engine="host")
+    registry.gauge("selftest_patterns", 1)
+    registry.event("selftest", phase="done")
+    dump = {"schema_version": SCHEMA_VERSION}
+    dump.update(registry.dump())
+    dump["spans"] = tracer.to_dict()
+    errors = validate_dump(dump)
+    if errors:
+        raise AssertionError(f"telemetry selftest dump invalid: "
+                             f"{errors}")
+    prom = registry.to_prometheus()
+    if "selftest_scrubs_total" not in prom:
+        raise AssertionError("prometheus exposition lost a counter")
+    json_a = tracer.to_json()
+    if not json_a or json_a != tracer.to_json():
+        raise AssertionError("span JSON export is not deterministic")
+    return dump
+
+
+__all__ = [
+    "LatencyHistogram",
+    "MetricsRegistry",
+    "SCHEMA_VERSION",
+    "Span",
+    "SpanTracer",
+    "bucket_index",
+    "bucket_lower",
+    "counter",
+    "dump_all",
+    "event",
+    "gauge",
+    "global_metrics",
+    "global_tracer",
+    "install_compile_monitor",
+    "observe",
+    "record_dispatch",
+    "reset_all",
+    "set_enabled",
+    "set_global_metrics",
+    "set_global_tracer",
+    "span",
+    "telemetry_selftest",
+    "validate_dump",
+]

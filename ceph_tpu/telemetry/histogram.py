@@ -1,0 +1,139 @@
+"""Log-bucketed latency histograms with exact quantile readout.
+
+The HdrHistogram discipline (and the reference's
+``PerfCounters::histogram`` / ``mon_command_latency`` axes): values are
+bucketed on a log2 grid with ``SUB`` linear sub-buckets per octave, so
+relative bucket resolution is 1/SUB (~1.6% at SUB=64) at every
+magnitude — microsecond dispatch latencies and multi-second recovery
+ops share one structure with bounded memory (a sparse dict of hit
+buckets, not a dense array).
+
+Quantile semantics (pinned by tests/test_telemetry.py):
+
+- ``quantile(p)`` returns the lower edge of the bucket containing rank
+  ``min(n, max(1, ceil(p * n)))``, clamped into ``[min, max]`` of the
+  exact observed extremes.  The clamp makes the degenerate cases
+  exact: a single-sample histogram answers every quantile with the
+  sample itself, and p=0/p=1 answer the true min/max.
+- A value on a bucket's lower edge lands in THAT bucket (half-open
+  ``[lower, upper)`` intervals), so boundary values round-trip
+  exactly through ``quantile``.
+- Empty histogram: every quantile is None.
+
+Everything is host-side pure Python — no numpy, no jax — so recording
+in the hot host paths costs two dict operations and the structure is
+safe inside the tpu-audit host tier (telemetry must compile nothing).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Optional
+
+# linear sub-buckets per power-of-two octave: relative resolution 1/64
+SUB = 64
+# frexp exponent bias so indexes stay non-negative for every positive
+# double (frexp exponents reach -1073 for subnormals)
+_EXP_BIAS = 1100
+
+
+def bucket_index(value: float) -> int:
+    """The bucket holding ``value`` (> 0); buckets are half-open
+    ``[lower, upper)`` on the log2/SUB grid."""
+    m, e = math.frexp(value)          # value = m * 2**e, m in [0.5, 1)
+    sub = int((m - 0.5) * 2 * SUB)
+    if sub >= SUB:                    # m == 1.0 - epsilon rounding guard
+        sub = SUB - 1
+    return (e + _EXP_BIAS) * SUB + sub
+
+
+def bucket_lower(index: int) -> float:
+    """The inclusive lower edge of bucket ``index``."""
+    e = index // SUB - _EXP_BIAS
+    sub = index % SUB
+    return (0.5 + sub / (2 * SUB)) * 2.0 ** e
+
+
+class LatencyHistogram:
+    """Sparse log-bucketed histogram over non-negative floats
+    (seconds by convention; the unit is the caller's contract)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._buckets: Dict[int, int] = {}
+        self._zeros = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def record(self, value: float) -> None:
+        if value < 0:
+            raise ValueError(f"latency {value} must be >= 0")
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            if self.min is None or value < self.min:
+                self.min = value
+            if self.max is None or value > self.max:
+                self.max = value
+            if value == 0.0:
+                self._zeros += 1
+            else:
+                idx = bucket_index(value)
+                self._buckets[idx] = self._buckets.get(idx, 0) + 1
+
+    def quantile(self, p: float) -> Optional[float]:
+        """See the module docstring for the exact semantics."""
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"quantile {p} must be in [0, 1]")
+        with self._lock:
+            if self.count == 0:
+                return None
+            rank = min(self.count, max(1, math.ceil(p * self.count)))
+            if rank == self.count:
+                # the top rank is the exact observed max, not its
+                # bucket's lower edge (p=1.0 — and every p once n*p
+                # rounds up to n — must answer the true max)
+                return self.max
+            cum = self._zeros
+            if cum >= rank:
+                return 0.0
+            for idx in sorted(self._buckets):
+                cum += self._buckets[idx]
+                if cum >= rank:
+                    lo = bucket_lower(idx)
+                    return max(self.min, min(lo, self.max))
+            return self.max  # unreachable unless counts drift
+
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        return {"p50": self.quantile(0.50),
+                "p99": self.quantile(0.99),
+                "p999": self.quantile(0.999)}
+
+    def to_dict(self) -> dict:
+        """Deterministic JSON-ready dump (bucket keys sorted as
+        strings of ints; byte-identical given identical recordings)."""
+        with self._lock:
+            buckets = {str(i): self._buckets[i]
+                       for i in sorted(self._buckets)}
+            if self._zeros:
+                buckets = {"zero": self._zeros, **buckets}
+            base = {"count": self.count, "sum": self.sum,
+                    "min": self.min, "max": self.max}
+        base.update(self.percentiles())
+        base["buckets"] = buckets
+        return base
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+            self._zeros = 0
+            self.count = 0
+            self.sum = 0.0
+            self.min = None
+            self.max = None
+
+
+__all__ = ["SUB", "LatencyHistogram", "bucket_index", "bucket_lower"]

@@ -1,0 +1,345 @@
+"""The unified metrics plane: labeled counters, gauges, histograms
+and structured events in one registry.
+
+This is the `perf dump` role of utils/perf.py grown into the plane
+ROADMAP items 3–4 need: every scattered ad-hoc counter (PatternCache
+hit/build/eviction, fallback tier transitions, retry/backoff/deadline,
+chaos injections, recovery fences/replans/regroups, jax.monitoring
+compile events) folds into ONE process registry with:
+
+- **labels** — series identity is (name, sorted label items), so the
+  fallback tier counter is one name with ``device=/engine=`` labels
+  instead of five booleans;
+- **kind safety** — a name belongs to exactly one kind (counter |
+  gauge | histogram); reusing it as another kind raises, the same
+  discipline the PerfCounters.dump() collision fix enforces on the
+  legacy registry;
+- **two exports** — ``dump()`` keeps the reference's
+  ``{registry: {counter: value | {...}}}`` perf-dump JSON shape, and
+  ``to_prometheus()`` emits Prometheus text exposition (counters as
+  ``_total``, histograms as quantile summaries) for scrape-based
+  consumption;
+- **injectable clock** — ``timed()``/``record_dispatch`` read the
+  registry clock, so FakeClock tests pin exact latencies.
+
+Host-side only by construction: no jax import at module scope, no
+compiles ever — asserted forever by the ``telemetry.selftest``
+host-tier entry in analysis/entrypoints.py (the jaxpr-audit sentinel
+fails if this module's representative workload compiles one program
+or returns one device array).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from .histogram import LatencyHistogram
+
+LabelKey = Tuple[Tuple[str, str], ...]
+SeriesKey = Tuple[str, LabelKey]
+
+MAX_EVENTS = 256
+
+
+class _SystemClock:
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+
+def _labels_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def series_name(name: str, labels: LabelKey) -> str:
+    """The dump key: ``name{k=v,...}`` (labels sorted), bare name
+    when unlabeled."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    """Process metrics registry (the admin-socket `perf dump` role,
+    labels included)."""
+
+    def __init__(self, name: str = "ceph_tpu_telemetry",
+                 clock=None) -> None:
+        self.name = name
+        self.clock = clock if clock is not None else _SystemClock()
+        self._lock = threading.Lock()
+        self._counters: Dict[SeriesKey, int] = {}
+        self._gauges: Dict[SeriesKey, float] = {}
+        self._hists: Dict[SeriesKey, LatencyHistogram] = {}
+        self._kinds: Dict[str, str] = {}
+        self._events: "deque[dict]" = deque(maxlen=MAX_EVENTS)
+        self._event_seq = 0
+
+    # -- kind discipline -------------------------------------------------
+
+    def _claim(self, name: str, kind: str) -> None:
+        owner = self._kinds.setdefault(name, kind)
+        if owner != kind:
+            raise ValueError(
+                f"metric {name!r} is a {owner}, not a {kind} — one "
+                f"name, one kind (the dump key would collide)")
+
+    # -- recording -------------------------------------------------------
+
+    def counter(self, name: str, value: int = 1, **labels) -> None:
+        if value < 0:
+            raise ValueError(f"counter {name!r} increment {value} < 0")
+        key = (name, _labels_key(labels))
+        with self._lock:
+            self._claim(name, "counter")
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            self._claim(name, "gauge")
+            self._gauges[key] = value
+
+    def histogram(self, name: str, **labels) -> LatencyHistogram:
+        """Get-or-create the labeled histogram series."""
+        key = (name, _labels_key(labels))
+        with self._lock:
+            self._claim(name, "histogram")
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = LatencyHistogram()
+            return hist
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        self.histogram(name, **labels).record(value)
+
+    def event(self, kind: str, **fields) -> None:
+        """Structured event stream (bounded; the log-once paths emit
+        here so the transition itself is inspectable, not just its
+        count)."""
+        with self._lock:
+            self._event_seq += 1
+            self._events.append(
+                {"seq": self._event_seq, "event": kind,
+                 **{k: fields[k] for k in sorted(fields)}})
+
+    @contextlib.contextmanager
+    def timed(self, name: str, **labels):
+        """Time a block into ``observe(name, elapsed, **labels)``."""
+        t0 = self.clock.monotonic()
+        try:
+            yield
+        finally:
+            self.observe(name, self.clock.monotonic() - t0, **labels)
+
+    # -- readout ---------------------------------------------------------
+
+    def counter_value(self, name: str, **labels) -> int:
+        return self._counters.get((name, _labels_key(labels)), 0)
+
+    def dump(self) -> dict:
+        """The `perf dump` JSON shape: ``{registry: {series: value}}``
+        (histograms dump their full bucket/quantile dict, events ride
+        under ``__events__``)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+            events = list(self._events)
+        out: Dict[str, object] = {}
+        for (name, labels), v in sorted(counters.items()):
+            out[series_name(name, labels)] = v
+        for (name, labels), v in sorted(gauges.items()):
+            out[series_name(name, labels)] = v
+        for (name, labels), h in sorted(hists.items()):
+            out[series_name(name, labels)] = h.to_dict()
+        if events:
+            out["__events__"] = events
+        return {self.name: out}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition: counters as ``*_total``,
+        gauges bare, histograms as quantile summaries with
+        ``_sum``/``_count``.  Names are sanitized (`.` → `_`) and
+        prefixed with the registry name."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+        lines = []
+
+        def _san(name: str) -> str:
+            return (self.name + "_" + name).replace(".", "_").replace(
+                "-", "_")
+
+        def _lbl(labels: LabelKey, extra: str = "") -> str:
+            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            if extra:
+                inner = f"{inner},{extra}" if inner else extra
+            return f"{{{inner}}}" if inner else ""
+
+        seen_c = set()
+        for (name, labels), v in sorted(counters.items()):
+            n = _san(name) + "_total"
+            if n not in seen_c:
+                seen_c.add(n)
+                lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n}{_lbl(labels)} {v}")
+        seen_g = set()
+        for (name, labels), v in sorted(gauges.items()):
+            n = _san(name)
+            if n not in seen_g:
+                seen_g.add(n)
+                lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n}{_lbl(labels)} {v}")
+        seen_h = set()
+        for (name, labels), h in sorted(hists.items()):
+            n = _san(name)
+            if n not in seen_h:
+                seen_h.add(n)
+                lines.append(f"# TYPE {n} summary")
+            pcts = h.percentiles()
+            for q, p in (("0.5", "p50"), ("0.99", "p99"),
+                         ("0.999", "p999")):
+                val = pcts[p]
+                if val is not None:
+                    extra = 'quantile="%s"' % q
+                    lines.append(f"{n}{_lbl(labels, extra)} {val}")
+            lines.append(f"{n}_sum{_lbl(labels)} {h.sum}")
+            lines.append(f"{n}_count{_lbl(labels)} {h.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self._kinds.clear()
+            self._events.clear()
+            self._event_seq = 0
+
+
+_global: Optional[MetricsRegistry] = None
+_global_lock = threading.Lock()
+_enabled = True
+
+
+def global_metrics() -> MetricsRegistry:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = MetricsRegistry()
+        return _global
+
+
+def set_global_metrics(registry: Optional[MetricsRegistry]
+                       ) -> Optional[MetricsRegistry]:
+    """Swap the process registry (tests); returns the previous one."""
+    global _global
+    with _global_lock:
+        prev = _global
+        _global = registry
+        return prev
+
+
+def set_enabled(on: bool) -> bool:
+    """Master recording switch (the perf_dump --check-overhead gate
+    measures enabled-vs-disabled on an identical workload).  Disabled
+    means every module-level convenience below is a cheap no-op; code
+    holding a registry object directly is unaffected."""
+    global _enabled
+    prev = _enabled
+    _enabled = on
+    return prev
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+# -- module-level conveniences (what the instrumented call sites use) ----
+
+def counter(name: str, value: int = 1, **labels) -> None:
+    if _enabled:
+        global_metrics().counter(name, value, **labels)
+
+
+def gauge(name: str, value: float, **labels) -> None:
+    if _enabled:
+        global_metrics().gauge(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    if _enabled:
+        global_metrics().observe(name, value, **labels)
+
+
+def event(kind: str, **fields) -> None:
+    if _enabled:
+        global_metrics().event(kind, **fields)
+
+
+@contextlib.contextmanager
+def record_dispatch(name: str, eager: bool = True, **labels):
+    """Time one device/host dispatch into ``<name>_seconds{labels}``
+    and count it in ``<name>_calls{labels}``.
+
+    ``eager=False`` (the call site is being traced by jax — its input
+    is a Tracer, so the body runs at trace time, not per dispatch)
+    records nothing: trace-time clock reads would be fiction, and the
+    no-op keeps jaxprs free of telemetry by construction.
+    """
+    if not (eager and _enabled):
+        yield
+        return
+    reg = global_metrics()
+    t0 = reg.clock.monotonic()
+    try:
+        yield
+    finally:
+        reg.observe(name + "_seconds",
+                    reg.clock.monotonic() - t0, **labels)
+        reg.counter(name + "_calls", **labels)
+
+
+# -- jax.monitoring bridge (compile events into the registry) -----------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_monitor_lock = threading.Lock()
+_monitor_installed = False
+
+
+def install_compile_monitor() -> bool:
+    """Register a jax.monitoring listener folding backend-compile
+    events into ``jax_backend_compiles`` (count) and
+    ``jax_backend_compile_seconds`` (histogram).  Idempotent; returns
+    False when jax is unavailable.
+    Deliberately NOT automatic: importing telemetry must never import
+    jax (the host-tier contract) — benches and the perf-dump CLI opt
+    in."""
+    global _monitor_installed
+    with _monitor_lock:
+        if _monitor_installed:
+            return True
+        try:
+            import jax.monitoring
+        except ImportError:
+            return False
+        def _listener(name: str, duration: float, **kw) -> None:
+            if name == _COMPILE_EVENT and _enabled:
+                reg = global_metrics()
+                reg.counter("jax_backend_compiles")
+                reg.observe("jax_backend_compile_seconds", duration)
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _monitor_installed = True
+        return True
+
+
+__all__ = ["MAX_EVENTS", "MetricsRegistry", "counter", "enabled",
+           "event", "gauge", "global_metrics", "install_compile_monitor",
+           "observe", "record_dispatch", "series_name",
+           "set_enabled", "set_global_metrics"]

@@ -1,0 +1,111 @@
+"""JSON shape validation for the unified telemetry dump.
+
+The telemetry gate in tools/test_full.sh runs a seeded repair
+scenario, dumps, and validates here — a refactor that silently drops
+a section (or emits a histogram without its quantiles) fails the gate
+instead of shipping a dump the round artifacts can't parse.  The
+validator is hand-rolled (stdlib-only: the container pins its
+dependency set) but the rules below ARE the schema, versioned by
+``SCHEMA_VERSION`` inside the dump itself.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+SCHEMA_VERSION = 1
+
+_HIST_REQUIRED = ("count", "sum", "min", "max", "p50", "p99", "p999",
+                  "buckets")
+_SPAN_REQUIRED = ("name", "start", "end", "duration")
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_hist(path: str, v: dict, errors: List[str]) -> None:
+    for k in _HIST_REQUIRED:
+        if k not in v:
+            errors.append(f"{path}: histogram missing {k!r}")
+    if not isinstance(v.get("count"), int) or v.get("count", 0) < 0:
+        errors.append(f"{path}: histogram count must be int >= 0")
+    if not isinstance(v.get("buckets", None), dict):
+        errors.append(f"{path}: histogram buckets must be an object")
+    if v.get("count"):
+        for q in ("p50", "p99", "p999", "min", "max"):
+            if not _is_num(v.get(q)):
+                errors.append(f"{path}: non-empty histogram {q} must "
+                              f"be a number")
+
+
+def _check_series(path: str, v, errors: List[str]) -> None:
+    if isinstance(v, dict):
+        if "buckets" in v:
+            _check_hist(path, v, errors)
+        elif set(v) == {"avgcount", "sum"}:
+            if not isinstance(v["avgcount"], int) or \
+                    not _is_num(v["sum"]):
+                errors.append(f"{path}: time pair must be "
+                              f"{{avgcount: int, sum: number}}")
+        else:
+            errors.append(f"{path}: unknown series object shape "
+                          f"{sorted(v)[:4]}")
+    elif not _is_num(v):
+        errors.append(f"{path}: series value must be a number")
+
+
+def _check_span(path: str, sp, errors: List[str]) -> None:
+    if not isinstance(sp, dict):
+        errors.append(f"{path}: span must be an object")
+        return
+    for k in _SPAN_REQUIRED:
+        if k not in sp:
+            errors.append(f"{path}: span missing {k!r}")
+    if not isinstance(sp.get("name"), str):
+        errors.append(f"{path}: span name must be a string")
+    if sp.get("end") is not None and _is_num(sp.get("start")) \
+            and _is_num(sp.get("end")) and sp["end"] < sp["start"]:
+        errors.append(f"{path}: span ends before it starts")
+    for i, child in enumerate(sp.get("children", ())):
+        _check_span(f"{path}.children[{i}]", child, errors)
+
+
+def validate_dump(dump: dict) -> List[str]:
+    """Validate the unified ``dump_all()`` shape; returns a list of
+    error strings (empty = valid)."""
+    errors: List[str] = []
+    if not isinstance(dump, dict):
+        return ["dump must be a JSON object"]
+    if dump.get("schema_version") != SCHEMA_VERSION:
+        errors.append(f"schema_version must be {SCHEMA_VERSION}")
+    spans = dump.get("spans")
+    if not isinstance(spans, dict) or "spans" not in spans \
+            or "dropped" not in spans:
+        errors.append("spans section must be {spans: [...], "
+                      "dropped: int}")
+    else:
+        for i, sp in enumerate(spans["spans"]):
+            _check_span(f"spans[{i}]", sp, errors)
+    registries = [k for k in dump
+                  if k not in ("schema_version", "spans")]
+    if not registries:
+        errors.append("dump carries no metric registries")
+    for reg in registries:
+        body = dump[reg]
+        if not isinstance(body, dict):
+            errors.append(f"{reg}: registry must be an object")
+            continue
+        for key, v in body.items():
+            if key == "__events__":
+                if not isinstance(v, list) or any(
+                        not isinstance(e, dict) or "event" not in e
+                        or "seq" not in e for e in v):
+                    errors.append(f"{reg}.__events__: events must be "
+                                  f"objects with event+seq")
+                continue
+            _check_series(f"{reg}.{key}", v, errors)
+    return errors
+
+
+__all__ = ["SCHEMA_VERSION", "validate_dump"]

@@ -1,0 +1,198 @@
+"""Deterministic span tracing for the host-side pipeline phases.
+
+A span is one timed phase (``repair`` → ``scrub``/``plan``/
+``dispatch``/``verify``/``write_back``); nesting follows the call
+stack via a thread-local, so ``recovery.run`` → ``round`` →
+``decode`` → ``repair`` trees assemble themselves when the recovery
+orchestrator calls into batched scrub repair.
+
+Design constraints (docs/OBSERVABILITY.md):
+
+- **Clock-injectable**: the tracer takes any object with
+  ``monotonic()`` — tests pass ``utils.retry.FakeClock`` and get
+  byte-identical ``to_json()`` output across runs; production uses the
+  real monotonic clock.
+- **Host-only by construction**: nothing here imports jax at module
+  scope and nothing ever compiles.  When jax is ALREADY loaded in the
+  process, span enter/exit additionally opens a
+  ``jax.profiler.TraceAnnotation`` with the span name, so a
+  TensorBoard device trace (utils.perf.profile_trace) shows the host
+  phases on the same timeline as the device kernels — pure profiler
+  metadata, no primitives, enforced forever by the telemetry host-tier
+  entry in analysis/entrypoints.py.
+- **Bounded**: finished root trees are kept in a deque of
+  ``max_roots``; overflow drops the oldest and counts ``dropped`` so a
+  long-running daemon cannot leak span memory.
+- **Observable live**: enter/exit emit through utils.log at debug
+  level 20 under the ``telemetry`` subsystem —
+  ``CEPH_TPU_DEBUG=telemetry=20`` streams the trace as it happens.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..utils.log import dout
+
+SPAN_DEBUG_LEVEL = 20   # dout level for span enter/exit events
+
+
+class _SystemClock:
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+
+class Span:
+    """One timed phase.  ``attrs`` are JSON-scalar annotations
+    (pattern keys, object counts, engine tiers)."""
+
+    __slots__ = ("name", "start", "end", "attrs", "children")
+
+    def __init__(self, name: str, start: float,
+                 attrs: Optional[Dict[str, object]] = None) -> None:
+        self.name = name
+        self.start = start
+        self.end: Optional[float] = None
+        self.attrs = dict(attrs or {})
+        self.children: List["Span"] = []
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    def to_dict(self) -> dict:
+        out: dict = {"name": self.name, "start": self.start,
+                     "end": self.end, "duration": self.duration}
+        if self.attrs:
+            out["attrs"] = {k: self.attrs[k] for k in sorted(self.attrs)}
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+
+class SpanTracer:
+    """Thread-aware span tree collector.
+
+    ``annotate=None`` (default) emits jax.profiler.TraceAnnotation
+    markers iff jax is already imported — it never forces the import,
+    so jax-free environments (the AST lint tier) stay jax-free.
+    """
+
+    def __init__(self, clock=None, max_roots: int = 256,
+                 annotate: Optional[bool] = None) -> None:
+        self.clock = clock if clock is not None else _SystemClock()
+        self.annotate = annotate
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.finished: "deque[Span]" = deque(maxlen=max_roots)
+        self.dropped = 0
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _annotation(self, name: str):
+        want = self.annotate
+        if want is None:
+            want = "jax" in sys.modules
+        if not want:
+            return None
+        try:
+            import jax.profiler
+            return jax.profiler.TraceAnnotation(name)
+        except Exception:  # noqa: BLE001 - profiling is best-effort
+            return None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Open a child span of the current thread's innermost open
+        span (a root when none is open).  Yields the Span so callers
+        can attach late attrs (e.g. the engine tier chosen inside).
+
+        Honors the master recording switch (metrics.set_enabled): when
+        telemetry is off, yields a throwaway span and records nothing
+        — the perf_dump --check-overhead gate measures exactly this
+        on/off delta."""
+        from .metrics import enabled
+        if not enabled():
+            yield Span(name, 0.0, attrs)
+            return
+        stack = self._stack()
+        sp = Span(name, self.clock.monotonic(), attrs)
+        path = "/".join([s.name for s in stack] + [name])
+        dout("telemetry", SPAN_DEBUG_LEVEL, f"span+ {path}")
+        stack.append(sp)
+        ann = self._annotation(name)
+        if ann is not None:
+            ann.__enter__()
+        try:
+            yield sp
+        finally:
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            sp.end = self.clock.monotonic()
+            stack.pop()
+            if stack:
+                stack[-1].children.append(sp)
+            else:
+                with self._lock:
+                    if len(self.finished) == self.finished.maxlen:
+                        self.dropped += 1
+                    self.finished.append(sp)
+            dout("telemetry", SPAN_DEBUG_LEVEL,
+                 f"span- {path} dur={sp.duration:.6f}s")
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            roots = [s.to_dict() for s in self.finished]
+            return {"spans": roots, "dropped": self.dropped}
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        """Deterministic export: sorted keys, fixed separators — two
+        runs with the same FakeClock schedule are byte-identical."""
+        return json.dumps(self.to_dict(), sort_keys=True, indent=indent,
+                          separators=(",", ": ") if indent else (",", ":"))
+
+    def reset(self) -> None:
+        with self._lock:
+            self.finished.clear()
+            self.dropped = 0
+
+
+_global: Optional[SpanTracer] = None
+_global_lock = threading.Lock()
+
+
+def global_tracer() -> SpanTracer:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = SpanTracer()
+        return _global
+
+
+def set_global_tracer(tracer: Optional[SpanTracer]
+                      ) -> Optional[SpanTracer]:
+    """Swap the process tracer (tests); returns the previous one."""
+    global _global
+    with _global_lock:
+        prev = _global
+        _global = tracer
+        return prev
+
+
+def span(name: str, **attrs):
+    """Convenience: a span on the process-global tracer."""
+    return global_tracer().span(name, **attrs)
+
+
+__all__ = ["SPAN_DEBUG_LEVEL", "Span", "SpanTracer", "global_tracer",
+           "set_global_tracer", "span"]

@@ -18,7 +18,10 @@ import threading
 import time
 from typing import Dict, Optional, TextIO
 
-SUBSYS = ("ec", "crush", "bench", "bridge", "registry")  # subsys.h role
+SUBSYS = ("ec", "crush", "bench", "bridge", "registry",
+          "telemetry")  # subsys.h role; telemetry: span enter/exit at
+                        # level 20 (CEPH_TPU_DEBUG=telemetry=20 gives a
+                        # live trace of the span tree as it opens)
 
 _levels: Dict[str, int] = {}
 _lock = threading.Lock()

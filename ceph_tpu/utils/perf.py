@@ -24,7 +24,13 @@ from typing import Dict, Optional
 class PerfCounters:
     """Named counters: u64 ``inc``, time-average ``tinc`` (count + sum
     seconds, like the reference's PERFCOUNTER_TIME|PERFCOUNTER_LONGRUNAVG
-    pairs), gauges via ``set``."""
+    pairs), gauges via ``set``.
+
+    One name, one kind: ``dump()`` flattens all three stores into a
+    single namespace, so a gauge reusing a u64/time counter's name
+    used to silently overwrite it in the dump.  Cross-kind reuse now
+    raises at record time instead (the telemetry registry in
+    ceph_tpu/telemetry/metrics.py enforces the same discipline)."""
 
     def __init__(self, name: str = "ceph_tpu") -> None:
         self.name = name
@@ -32,19 +38,30 @@ class PerfCounters:
         self._u64: Dict[str, int] = {}
         self._time: Dict[str, list] = {}   # name -> [count, sum_seconds]
         self._gauge: Dict[str, float] = {}
+        self._kind: Dict[str, str] = {}
+
+    def _claim(self, counter: str, kind: str) -> None:
+        owner = self._kind.setdefault(counter, kind)
+        if owner != kind:
+            raise ValueError(
+                f"perf counter {counter!r} is a {owner}, not a {kind} "
+                f"— the flat dump namespace would collide")
 
     def inc(self, counter: str, v: int = 1) -> None:
         with self._lock:
+            self._claim(counter, "u64")
             self._u64[counter] = self._u64.get(counter, 0) + v
 
     def tinc(self, counter: str, seconds: float) -> None:
         with self._lock:
+            self._claim(counter, "time")
             entry = self._time.setdefault(counter, [0, 0.0])
             entry[0] += 1
             entry[1] += seconds
 
     def set_gauge(self, counter: str, v: float) -> None:
         with self._lock:
+            self._claim(counter, "gauge")
             self._gauge[counter] = v
 
     @contextlib.contextmanager
@@ -61,6 +78,7 @@ class PerfCounters:
             self._u64.clear()
             self._time.clear()
             self._gauge.clear()
+            self._kind.clear()
 
     def dump(self) -> dict:
         """`ceph daemon X perf dump` shape: {registry: {counter: value

@@ -137,6 +137,7 @@ def retry_call(fn: Callable, *args,
     back.  ``rng`` seeds the decorrelated-jitter draw when the policy
     asks for it.
     """
+    from ..telemetry import metrics as tel
     policy = policy or RetryPolicy()
     clock = clock or SystemClock()
     start = clock.monotonic()
@@ -152,6 +153,11 @@ def retry_call(fn: Callable, *args,
             return fn(*args, **kwargs)
         except policy.retry_on as e:
             last = e
+            # only failures touch the telemetry plane: the clean
+            # first-try path (every shard read in a healthy scrub)
+            # records nothing, keeping the overhead gate honest
+            tel.counter("retry_attempts",
+                        error=type(e).__name__)
             if attempt + 1 >= policy.attempts:
                 break
             d = policy.delay(attempt, prev_delay=prev_delay, rng=rng)
@@ -167,7 +173,11 @@ def retry_call(fn: Callable, *args,
                 stats.delays.append(d)
             if on_retry is not None:
                 on_retry(attempt, d, e)
+            tel.observe("retry_backoff_seconds", d)
             clock.sleep(d)
     elapsed = clock.monotonic() - start
+    tel.counter("retry_exhausted")
+    if deadline_expired:
+        tel.counter("retry_deadline_expired")
     raise RetryExhausted(attempts_made, last, elapsed=elapsed,
                          deadline_expired=deadline_expired) from last

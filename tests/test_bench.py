@@ -261,11 +261,21 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    assert bench.METRIC_VERSION == 2
+    assert bench.METRIC_VERSION == 3
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["metric_version"] == bench.METRIC_VERSION
+    # metric_version 3: every emitted line carries the telemetry blob
+    assert isinstance(err["telemetry"], dict)
+    # and bench rows are {gbps, lat_*} dicts (per-stripe-batch
+    # latency percentiles alongside GB/s)
+    row = bench._row_result({"gbps": 1.23456789, "lat_p50_ms": 0.5,
+                             "lat_p99_ms": 0.9, "lat_p999_ms": 1.0,
+                             "lat_samples": 7})
+    assert row == {"gbps": 1.2346, "lat_p50_ms": 0.5,
+                   "lat_p99_ms": 0.9, "lat_p999_ms": 1.0,
+                   "lat_samples": 7}
     # the official decode rows route shec through the packed slice
     # chain and clay through packed carry (MXU composites are not
     # DCE-opaque, so slice would be fiction there)

@@ -1,0 +1,557 @@
+"""Telemetry subsystem (ISSUE 6): span tracing, latency histograms,
+the unified metrics registry, and the instrumented pipeline.
+
+The load-bearing claims pinned here:
+
+- quantile math is exact at the edges (empty / single sample / bucket
+  boundaries) and monotone;
+- FakeClock-driven span trees and metric dumps are BYTE-identical
+  across runs (the determinism contract tools/perf_dump.py
+  --fake-clock demos);
+- a seeded repair_batched + recovery-churn run records the
+  PatternCache, fallback-tier, retry, chaos and recovery-fence
+  counters with values that match the pipeline's own reports;
+- the legacy utils/perf.py dump can no longer silently lose a counter
+  to a same-named gauge (the PR's regression fix);
+- the telemetry plane is registered as a host-tier audit entry and
+  compiles nothing.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu import telemetry
+from ceph_tpu.telemetry import (
+    LatencyHistogram,
+    MetricsRegistry,
+    SpanTracer,
+    validate_dump,
+)
+from ceph_tpu.telemetry.histogram import bucket_index, bucket_lower
+from ceph_tpu.utils.perf import PerfCounters, global_perf
+from ceph_tpu.utils.retry import FakeClock
+
+
+# ----------------------------------------------------------------------
+# histogram quantile math
+
+def test_histogram_empty():
+    h = LatencyHistogram()
+    assert h.quantile(0.5) is None
+    assert h.percentiles() == {"p50": None, "p99": None, "p999": None}
+    d = h.to_dict()
+    assert d["count"] == 0 and d["buckets"] == {}
+
+
+def test_histogram_single_sample_is_exact_everywhere():
+    h = LatencyHistogram()
+    h.record(0.00417)
+    for p in (0.0, 0.001, 0.5, 0.99, 0.999, 1.0):
+        assert h.quantile(p) == 0.00417
+
+
+def test_histogram_bucket_boundary_roundtrip():
+    # a value exactly on a bucket's lower edge lands in that bucket
+    # and reads back exactly through quantile()
+    edge = bucket_lower(bucket_index(0.001))
+    h = LatencyHistogram()
+    h.record(edge)
+    assert h.quantile(0.5) == edge
+    # the half-open interval: nudging below the edge moves buckets
+    assert bucket_index(edge) != bucket_index(edge * (1 - 1e-12))
+
+
+def test_histogram_quantiles_monotone_and_tail():
+    h = LatencyHistogram()
+    for v in [0.001] * 50 + [0.010] * 49 + [1.0]:
+        h.record(v)
+    p = h.percentiles()
+    assert p["p50"] == 0.001                    # exact: min clamp
+    assert 0.009 <= p["p99"] <= 0.010           # bucket resolution
+    assert p["p999"] == 1.0                     # exact: max clamp
+    assert p["p50"] <= p["p99"] <= p["p999"]
+    assert h.quantile(0.0) == 0.001 and h.quantile(1.0) == 1.0
+    # p=1.0 is the exact observed max even OFF a bucket edge (found by
+    # the external verify pass: the bucket lower edge of 0.004 is
+    # ~0.00396, and the top rank must never answer below the max)
+    h2 = LatencyHistogram()
+    for v in (0.001, 0.002, 0.004):
+        h2.record(v)
+    assert h2.quantile(1.0) == 0.004
+    assert h2.quantile(0.999) == 0.004
+
+
+def test_histogram_zero_and_validation():
+    h = LatencyHistogram()
+    h.record(0.0)
+    h.record(0.5)
+    assert h.quantile(0.25) == 0.0
+    assert h.to_dict()["buckets"]["zero"] == 1
+    with pytest.raises(ValueError):
+        h.record(-1e-9)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_relative_resolution():
+    # 64 sub-buckets per octave: lower edge within ~1.6% of any value
+    for v in (1e-6, 3.7e-4, 0.042, 1.9, 123.456):
+        lo = bucket_lower(bucket_index(v))
+        assert lo <= v < lo * (1 + 1 / 32)
+
+
+# ----------------------------------------------------------------------
+# the legacy perf registry collision fix (satellite 1)
+
+def test_perf_dump_rejects_cross_kind_collision():
+    p = PerfCounters("t")
+    p.inc("x")
+    with pytest.raises(ValueError, match="u64, not a gauge"):
+        p.set_gauge("x", 1.0)
+    with pytest.raises(ValueError, match="u64, not a time"):
+        p.tinc("x", 0.1)
+    # distinct names of every kind coexist and all survive dump()
+    p.set_gauge("g", 2.5)
+    p.tinc("t0", 0.1)
+    d = p.dump()["t"]
+    assert d["x"] == 1 and d["g"] == 2.5
+    assert d["t0"] == {"avgcount": 1, "sum": pytest.approx(0.1)}
+    # reset clears the kind table too
+    p.reset()
+    p.set_gauge("x", 3.0)
+    assert p.dump()["t"]["x"] == 3.0
+
+
+# ----------------------------------------------------------------------
+# metrics registry
+
+def test_registry_labeled_series_and_kinds():
+    clk = FakeClock()
+    reg = MetricsRegistry(name="r", clock=clk)
+    reg.counter("calls", engine="xla")
+    reg.counter("calls", engine="xla")
+    reg.counter("calls", engine="mxu")
+    reg.gauge("depth", 4)
+    with reg.timed("op_seconds", engine="xla"):
+        clk.sleep(0.25)
+    d = reg.dump()["r"]
+    assert d["calls{engine=mxu}"] == 1
+    assert d["calls{engine=xla}"] == 2
+    assert d["depth"] == 4
+    assert d["op_seconds{engine=xla}"]["count"] == 1
+    assert d["op_seconds{engine=xla}"]["p50"] == pytest.approx(0.25,
+                                                               rel=0.02)
+    with pytest.raises(ValueError, match="counter, not a gauge"):
+        reg.gauge("calls", 1)
+    with pytest.raises(ValueError, match="negative|< 0"):
+        reg.counter("calls", -1)
+
+
+def test_registry_prometheus_exposition():
+    reg = MetricsRegistry(clock=FakeClock())
+    reg.counter("fallback_tier_transitions", device="cpu", engine="xla")
+    reg.observe("dispatch_seconds", 0.004, engine="pallas")
+    reg.gauge("patterns", 12)
+    text = reg.to_prometheus()
+    assert ('ceph_tpu_telemetry_fallback_tier_transitions_total'
+            '{device="cpu",engine="xla"} 1') in text
+    assert "# TYPE ceph_tpu_telemetry_dispatch_seconds summary" in text
+    assert 'quantile="0.999"' in text
+    assert 'ceph_tpu_telemetry_dispatch_seconds_count{engine="pallas"} 1' \
+        in text
+    assert "ceph_tpu_telemetry_patterns 12" in text
+
+
+def test_registry_events_bounded():
+    reg = MetricsRegistry()
+    for i in range(telemetry.metrics.MAX_EVENTS + 10):
+        reg.event("e", i=i)
+    events = reg.dump()[reg.name]["__events__"]
+    assert len(events) == telemetry.metrics.MAX_EVENTS
+    assert events[-1]["seq"] == telemetry.metrics.MAX_EVENTS + 10
+
+
+# ----------------------------------------------------------------------
+# span tracing
+
+def test_span_tree_deterministic_json():
+    def build():
+        clk = FakeClock()
+        tr = SpanTracer(clock=clk, annotate=False)
+        with tr.span("repair", objects=3):
+            with tr.span("scrub"):
+                clk.sleep(0.010)
+            with tr.span("dispatch", engine="host") as sp:
+                clk.sleep(0.002)
+                sp.attrs["batch"] = 0
+        return tr.to_json()
+
+    j1, j2 = build(), build()
+    assert j1 == j2
+    tree = json.loads(j1)
+    (root,) = tree["spans"]
+    assert root["name"] == "repair"
+    assert [c["name"] for c in root["children"]] == ["scrub", "dispatch"]
+    assert root["children"][0]["duration"] == 0.010
+    assert root["children"][1]["attrs"] == {"batch": 0,
+                                            "engine": "host"}
+    assert root["duration"] == pytest.approx(0.012)
+
+
+def test_span_overflow_bounded():
+    tr = SpanTracer(clock=FakeClock(), max_roots=4, annotate=False)
+    for i in range(7):
+        with tr.span(f"s{i}"):
+            pass
+    d = tr.to_dict()
+    assert len(d["spans"]) == 4 and d["dropped"] == 3
+    assert d["spans"][0]["name"] == "s3"
+
+
+def test_span_enter_exit_emits_telemetry_dout():
+    from ceph_tpu.utils.log import set_level, set_stream
+    buf = io.StringIO()
+    set_stream(buf)
+    set_level("telemetry", 20)
+    try:
+        tr = SpanTracer(clock=FakeClock(), annotate=False)
+        with tr.span("repair"):
+            with tr.span("scrub"):
+                pass
+    finally:
+        set_level("telemetry", 1)
+        set_stream(None)
+    out = buf.getvalue()
+    assert "span+ repair" in out and "span+ repair/scrub" in out
+    assert "span- repair/scrub dur" in out and "span- repair dur" in out
+
+
+def test_set_enabled_master_switch():
+    prev_reg = telemetry.set_global_metrics(MetricsRegistry())
+    prev_tr = telemetry.set_global_tracer(
+        SpanTracer(clock=FakeClock(), annotate=False))
+    try:
+        telemetry.set_enabled(False)
+        telemetry.counter("c")
+        telemetry.observe("h", 0.1)
+        with telemetry.span("s"):
+            pass
+        with telemetry.record_dispatch("d"):
+            pass
+        assert telemetry.global_metrics().dump()[
+            telemetry.global_metrics().name] == {}
+        assert telemetry.global_tracer().to_dict()["spans"] == []
+    finally:
+        telemetry.set_enabled(True)
+        telemetry.set_global_metrics(prev_reg)
+        telemetry.set_global_tracer(prev_tr)
+
+
+# ----------------------------------------------------------------------
+# the seeded pipeline scenarios (the acceptance gate)
+
+def _fresh_world(clk):
+    """Swap every process-global observability surface (and the
+    pattern cache + fallback policy, which would otherwise carry warm
+    state between runs) for a deterministic scenario run."""
+    from ceph_tpu.codes.engine import (PatternCache,
+                                       set_global_pattern_cache)
+    from ceph_tpu.ops.fallback import FallbackPolicy, set_global_policy
+    state = (telemetry.set_global_tracer(SpanTracer(clock=clk,
+                                                    annotate=False)),
+             telemetry.set_global_metrics(MetricsRegistry(clock=clk)),
+             set_global_pattern_cache(PatternCache()),
+             set_global_policy(FallbackPolicy()))
+    global_perf().reset()
+    return state
+
+
+def _restore_world(state):
+    from ceph_tpu.codes.engine import set_global_pattern_cache
+    from ceph_tpu.ops.fallback import set_global_policy
+    tr, reg, cache, policy = state
+    telemetry.set_global_tracer(tr)
+    telemetry.set_global_metrics(reg)
+    set_global_pattern_cache(cache)
+    set_global_policy(policy)
+
+
+def _repair_scenario(seed=7, objects=5):
+    from ceph_tpu.chaos import (BitFlip, ShardErasure, TransientErrors,
+                                inject)
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    from ceph_tpu.codes.stripe import HashInfo, StripeInfo
+    from ceph_tpu.codes.stripe import encode as stripe_encode
+    from ceph_tpu.scrub import repair_batched
+
+    clk = FakeClock()
+    state = _fresh_world(clk)
+    try:
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jerasure", {"technique": "reed_sol_van",
+                         "k": "4", "m": "2"})
+        n = ec.get_chunk_count()
+        cs = ec.get_chunk_size(8192)
+        sinfo = StripeInfo(4, 4 * cs)
+        rng = np.random.default_rng(seed)
+        stores, hinfos = [], []
+        for i in range(objects):
+            obj = rng.integers(0, 256, 4 * cs,
+                               dtype=np.uint8).tobytes()
+            shards = stripe_encode(sinfo, ec, obj)
+            h = HashInfo(n)
+            h.append(0, shards)
+            injectors = [ShardErasure(shards=[i % n]),
+                         TransientErrors(shards=[(i + 1) % n],
+                                         count=1)]
+            if i == 0:
+                injectors.append(BitFlip(shards=[(i + 2) % n],
+                                         flips=1))
+            store, _ = inject(shards, injectors, seed=seed + i,
+                              chunk_size=cs)
+            stores.append(store)
+            hinfos.append(h)
+        rep = repair_batched(sinfo, ec, stores, hinfos, clock=clk)
+        span_json = telemetry.global_tracer().to_json()
+        dump = telemetry.dump_all()
+        return rep, span_json, dump
+    finally:
+        _restore_world(state)
+
+
+def test_repair_scenario_deterministic_and_counters_correct():
+    rep1, spans1, dump1 = _repair_scenario()
+    rep2, spans2, dump2 = _repair_scenario()
+    # byte-identical observability across identical seeded runs
+    assert spans1 == spans2
+    assert json.dumps(dump1, sort_keys=True) == \
+        json.dumps(dump2, sort_keys=True)
+    assert validate_dump(dump1) == []
+    tel = dump1["ceph_tpu_telemetry"]
+    # chaos counters: 5 erasures, 5 transients, 1 bitflip
+    assert tel["chaos_injections{kind=erase}"] == 5
+    assert tel["chaos_injections{kind=transient}"] == 5
+    assert tel["chaos_injections{kind=bitflip}"] == 1
+    # retry plane: each armed transient read fails exactly once
+    assert tel["retry_attempts{error=TransientBackendError}"] == 5
+    assert tel["retry_backoff_seconds"]["count"] == 5
+    # pattern cache: fresh cache, so every composite build is counted
+    # (the fused repair program is one entry per erasure pattern)
+    assert tel["pattern_cache_builds"] >= rep1.pattern_batches >= 1
+    # fallback tier transition: logged once, counted once
+    (fb_key,) = [k for k in tel
+                 if k.startswith("fallback_tier_transitions")]
+    assert tel[fb_key] == 1
+    events = [e for e in tel["__events__"]
+              if e["event"] == "fallback_tier"]
+    assert len(events) == 1
+    # one fused dispatch histogram sample per pattern batch
+    eng = "device" if rep1.device_calls else "host"
+    assert tel[f"scrub_dispatch_calls{{engine={eng}}}"] == \
+        rep1.pattern_batches
+    assert tel[f"scrub_dispatch_seconds{{engine={eng}}}"]["count"] == \
+        rep1.pattern_batches
+    assert tel["repair_pattern_batches"] == rep1.pattern_batches
+    assert tel["scrub_deep_scrub_seconds"]["count"] == 5
+    # span taxonomy: repair root with scrub/plan/dispatch/verify/
+    # write_back children
+    tree = json.loads(spans1)
+    (root,) = tree["spans"]
+    assert root["name"] == "repair"
+    names = [c["name"] for c in root["children"]]
+    assert names[0] == "scrub" and names[1] == "plan"
+    assert "dispatch" in names and "verify" in names
+    assert "write_back" in names
+    dispatch = next(c for c in root["children"]
+                    if c["name"] == "dispatch")
+    assert dispatch["attrs"]["engine"] in ("device", "host")
+    # everything healed (the telemetry rode a real repair)
+    assert all(r.crc_verified for r in rep1.reports)
+
+
+def _recovery_scenario(seed=11, objects=4):
+    from ceph_tpu.chaos import MapChurn, ShardErasure, inject
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    from ceph_tpu.codes.stripe import HashInfo, StripeInfo
+    from ceph_tpu.codes.stripe import encode as stripe_encode
+    from ceph_tpu.crush import (CrushBuilder, step_chooseleaf_indep,
+                                step_emit, step_take)
+    from ceph_tpu.crush.osdmap import OSDMap, PGPool
+    from ceph_tpu.recovery import healed, recover_to_completion
+
+    clk = FakeClock()
+    state = _fresh_world(clk)
+    try:
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jerasure", {"technique": "reed_sol_van",
+                         "k": "4", "m": "2"})
+        n = ec.get_chunk_count()
+        cs = ec.get_chunk_size(8192)
+        sinfo = StripeInfo(4, 4 * cs)
+        rng = np.random.default_rng(seed)
+        originals, stores, hinfos = [], [], []
+        for i in range(objects):
+            obj = rng.integers(0, 256, 4 * cs,
+                               dtype=np.uint8).tobytes()
+            shards = stripe_encode(sinfo, ec, obj)
+            h = HashInfo(n)
+            h.append(0, shards)
+            store, _ = inject(shards, [ShardErasure(shards=[i % n])],
+                              seed=seed + i, chunk_size=cs)
+            originals.append(shards)
+            stores.append(store)
+            hinfos.append(h)
+        b = CrushBuilder()
+        root = b.build_two_level(n + 3, 2)
+        b.add_rule(0, [step_take(root),
+                       step_chooseleaf_indep(n, b.type_id("host")),
+                       step_emit()])
+        osdmap = OSDMap(crush=b.map)
+        osdmap.pools[1] = PGPool(pool_id=1, pg_num=16, size=n,
+                                 erasure=True)
+        churn = MapChurn(seed=seed, max_down=1, fire_every=2,
+                         stages=("dispatch",))
+        rep = recover_to_completion(sinfo, ec, osdmap, 1, 9, stores,
+                                    hinfos, churn=churn, clock=clk)
+        assert rep.converged and healed(stores, originals)
+        dump = telemetry.dump_all()
+        spans = telemetry.global_tracer().to_dict()
+        return rep, dump, spans
+    finally:
+        _restore_world(state)
+
+
+def test_recovery_scenario_counters_match_report():
+    rep, dump, spans = _recovery_scenario()
+    assert validate_dump(dump) == []
+    tel = dump["ceph_tpu_telemetry"]
+
+    def c(name):
+        return tel.get(name, 0)
+
+    # the recovery counters ARE the report, observed via telemetry
+    assert c("recovery_ops_completed") == rep.ops_completed > 0
+    assert c("recovery_replans") == rep.replans
+    assert c("recovery_fence_deferrals") == rep.fence_deferrals
+    assert c("recovery_regroups") == rep.regroups
+    assert c("recovery_journal_replays") == rep.journal_replays >= 1
+    assert c("recovery_throttle_deferrals") == \
+        rep.throttle_deferrals
+    assert c("recovery_ops_planned") >= rep.ops_completed
+    # the fence actually ran under churn (the scenario is tuned so
+    # the map moves between plan and write-back at least once)
+    assert rep.replans + rep.regroups + rep.fence_deferrals >= 1
+    # end-to-end op latency histogram: one sample per completed op,
+    # measured on the injectable clock
+    assert tel["recovery_op_seconds"]["count"] == rep.ops_completed
+    # chaos plane saw the churn events
+    churn_keys = [k for k in tel
+                  if k.startswith("chaos_injections{kind=churn_")]
+    assert churn_keys and sum(tel[k] for k in churn_keys) >= 1
+    # span taxonomy: recovery.run root, journal_replay + plan +
+    # round(decode → nested repair, writeback)
+    roots = [s["name"] for s in spans["spans"]]
+    assert "recovery.run" in roots
+    run = next(s for s in spans["spans"]
+               if s["name"] == "recovery.run")
+    child_names = [c_["name"] for c_ in run["children"]]
+    assert child_names[0] == "journal_replay"
+    assert "plan" in child_names and "round" in child_names
+    rnd = next(c_ for c_ in run["children"] if c_["name"] == "round")
+    decode = next(c_ for c_ in rnd["children"]
+                  if c_["name"] == "decode")
+    assert [c_["name"] for c_ in decode["children"]] == ["repair"]
+
+
+# ----------------------------------------------------------------------
+# engine-tier dispatch labels (ops layer)
+
+def test_apply_matrix_best_records_engine_label_eager_only():
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.pallas_gf import apply_matrix_best
+    from ceph_tpu.ops.xla_ops import matrix_to_static
+
+    m = np.array([[1, 1, 1, 1], [1, 2, 4, 8]], dtype=np.uint8)
+    ms = matrix_to_static(m)
+    x = np.random.default_rng(0).integers(
+        0, 256, (2, 4, 512), dtype=np.uint8)
+    prev = telemetry.set_global_metrics(MetricsRegistry())
+    try:
+        np.asarray(apply_matrix_best(jnp.asarray(x), ms, 8))
+        d = telemetry.global_metrics().dump()["ceph_tpu_telemetry"]
+        (key,) = [k for k in d if k.startswith("ops_apply_matrix_calls")]
+        assert "layout=bytes" in key and "engine=" in key
+        assert d[key] == 1
+        # traced calls record NOTHING: the jaxpr stays telemetry-free
+        telemetry.set_global_metrics(MetricsRegistry())
+        jitted = jax.jit(lambda a: apply_matrix_best(a, ms, 8))
+        np.asarray(jitted(jnp.asarray(x)))
+        np.asarray(jitted(jnp.asarray(x)))
+        d = telemetry.global_metrics().dump()["ceph_tpu_telemetry"]
+        assert not [k for k in d if k.startswith("ops_apply_matrix")]
+    finally:
+        telemetry.set_global_metrics(prev)
+
+
+# ----------------------------------------------------------------------
+# audit registration (the host/device boundary, forever)
+
+def test_telemetry_registered_as_host_tier_entry():
+    from ceph_tpu.analysis.entrypoints import registry, registry_gaps
+    eps = {e.name: e for e in registry()}
+    ep = eps["telemetry.selftest"]
+    assert ep.kind == "host" and ep.family == "telemetry"
+    assert ep.trace_budget == 0
+    assert registry_gaps() == []
+
+
+def test_telemetry_selftest_compiles_nothing():
+    from ceph_tpu.analysis.entrypoints import registry
+    from ceph_tpu.analysis.jaxpr_audit import run_sentinel
+    ep = {e.name: e for e in registry()}["telemetry.selftest"]
+    audit = run_sentinel(ep)
+    assert audit.ok, [f.render() for f in audit.findings]
+    assert audit.cold_compiles == 0 and audit.warm_compiles == 0
+
+
+# ----------------------------------------------------------------------
+# schema
+
+def test_schema_catches_broken_dumps():
+    good = telemetry.telemetry_selftest()
+    assert validate_dump(good) == []
+    assert validate_dump({"schema_version": 99}) != []
+    bad = json.loads(json.dumps(good))
+    del bad["spans"]["dropped"]
+    assert any("spans" in e for e in validate_dump(bad))
+    bad2 = json.loads(json.dumps(good))
+    reg_name = next(k for k in bad2
+                    if k not in ("schema_version", "spans"))
+    hist_key = next(k for k, v in bad2[reg_name].items()
+                    if isinstance(v, dict) and "buckets" in v)
+    del bad2[reg_name][hist_key]["p999"]
+    assert any("p999" in e for e in validate_dump(bad2))
+
+
+# ----------------------------------------------------------------------
+# bench integration (metric_version 3 lat fields)
+
+def test_bench_rows_report_latency_percentiles():
+    from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+    bench = ErasureCodeBench()
+    bench.setup(["--plugin", "jerasure", "-P", "k=4", "-P", "m=2",
+                 "--size", "4096", "--batch", "2", "--iterations", "4",
+                 "--workload", "degraded", "-e", "1",
+                 "--device", "host"])
+    res = bench.run()
+    assert res["lat_samples"] == 4
+    assert 0 < res["lat_p50_ms"] <= res["lat_p99_ms"] \
+        <= res["lat_p999_ms"]
+    assert res["gbps"] > 0

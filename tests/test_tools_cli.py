@@ -394,3 +394,34 @@ def test_recovery_demo_list_sites():
                        capture_output=True, text=True, cwd=REPO_ROOT)
     assert r.returncode == 0
     assert tuple(r.stdout.split()) == CRASH_SITES
+
+
+def test_perf_dump_cli_deterministic_and_valid():
+    """tools/perf_dump.py (docs/OBSERVABILITY.md): the seeded repair
+    scenario under --fake-clock emits a schema-valid unified dump
+    that is BYTE-identical across runs, and --format prom emits
+    Prometheus text exposition for the same registry."""
+    import os
+    script = os.path.join(REPO_ROOT, "tools", "perf_dump.py")
+
+    def dump_run():
+        return subprocess.run(
+            [sys.executable, script, "--scenario", "repair",
+             "--fake-clock", "--validate", "--format", "json"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+
+    r1, r2 = dump_run(), dump_run()
+    assert r1.returncode == 0, r1.stderr
+    assert r1.stdout == r2.stdout          # byte-identical dump
+    dump = json.loads(r1.stdout)
+    tel = dump["ceph_tpu_telemetry"]
+    assert tel["chaos_injections{kind=erase}"] >= 1
+    assert dump["spans"]["spans"][0]["name"] == "repair"
+
+    r = subprocess.run(
+        [sys.executable, script, "--scenario", "repair",
+         "--fake-clock", "--format", "prom"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stderr
+    assert "ceph_tpu_telemetry_scrub_dispatch_seconds" in r.stdout
+    assert "_total" in r.stdout and "quantile=" in r.stdout

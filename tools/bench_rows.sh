@@ -9,6 +9,11 @@
 # BENCH_ROWS_LAST_GOOD.jsonl — so a later tunnel outage still leaves
 # per-row numbers with provenance (VERDICT r03 Next#3).
 #
+# Since metric_version 3 each row additionally carries
+# lat_p50_ms/lat_p99_ms/lat_p999_ms/lat_samples (per-stripe-batch
+# latency percentiles, docs/OBSERVABILITY.md); consumers that only
+# read `gbps` are unaffected — rows are appended verbatim.
+#
 # The axon tunnel wedges at times (see bench.py _device_reachable);
 # probe first:
 #   timeout 100 python -c "import jax; print(len(jax.devices()))"

@@ -1,0 +1,235 @@
+#!/usr/bin/env python3
+"""perf_dump — the admin-socket `perf dump` CLI for the telemetry plane.
+
+Runs a seeded repair / recovery scenario through the instrumented
+pipeline (scrub → batched repair → recovery orchestrator under
+MapChurn), then emits the unified observability dump — the
+`{registry: {counter: value}}` perf-dump JSON shape plus span trees —
+and/or Prometheus text exposition.  docs/OBSERVABILITY.md documents
+the span taxonomy and metric names.
+
+The telemetry gate in tools/test_full.sh runs this three ways:
+
+    perf_dump.py --scenario repair --validate          # schema gate
+    perf_dump.py --scenario recovery-churn --fake-clock --validate
+    perf_dump.py --check-overhead 3                    # <=3% overhead
+                                                       # on the host
+                                                       # bench row
+
+Exit codes: 0 ok · 1 schema validation failed · 3 overhead above the
+threshold · 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the gate runs in CI without a TPU; pin CPU before jax loads so a
+# wedged axon tunnel can never hang the telemetry gate
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from ceph_tpu import telemetry  # noqa: E402
+
+
+def _build_objects(seed: int, objects: int, profile=None):
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    from ceph_tpu.codes.stripe import HashInfo, StripeInfo
+    from ceph_tpu.codes.stripe import encode as stripe_encode
+
+    profile = profile or {"technique": "reed_sol_van",
+                          "k": "4", "m": "2"}
+    ec = ErasureCodePluginRegistry.instance().factory("jerasure",
+                                                      dict(profile))
+    n = ec.get_chunk_count()
+    k = ec.get_data_chunk_count()
+    cs = ec.get_chunk_size(1 << 14)
+    sinfo = StripeInfo(k, k * cs)
+    rng = np.random.default_rng(seed)
+    shards_list, hinfos = [], []
+    for _ in range(objects):
+        obj = rng.integers(0, 256, k * cs, dtype=np.uint8).tobytes()
+        shards = stripe_encode(sinfo, ec, obj)
+        h = HashInfo(n)
+        h.append(0, shards)
+        shards_list.append(shards)
+        hinfos.append(h)
+    return ec, sinfo, n, shards_list, hinfos
+
+
+def _faulted_stores(seed: int, n: int, shards_list, chunk_size: int):
+    from ceph_tpu.chaos import (BitFlip, ShardErasure, TransientErrors,
+                                inject)
+    stores = []
+    for i, shards in enumerate(shards_list):
+        injectors = [ShardErasure(shards=[i % n])]
+        if i % 3 == 0:
+            injectors.append(BitFlip(shards=[(i + 1) % n], flips=1))
+        if i % 4 == 0:
+            injectors.append(TransientErrors(shards=[(i + 2) % n],
+                                             count=1))
+        store, _ = inject(shards, injectors, seed=seed + i,
+                          chunk_size=chunk_size)
+        stores.append(store)
+    return stores
+
+
+def run_repair_scenario(seed: int, objects: int, clock=None) -> None:
+    """Seeded deep_scrub → repair_batched pass (the acceptance
+    scenario's first half): erasures + a bit-flip + a transient read
+    error, so the PatternCache, retry, chaos and dispatch series all
+    take real values."""
+    from ceph_tpu.scrub import repair_batched
+
+    ec, sinfo, n, shards_list, hinfos = _build_objects(seed, objects)
+    stores = _faulted_stores(seed, n, shards_list, sinfo.chunk_size)
+    rep = repair_batched(sinfo, ec, stores, hinfos, clock=clock)
+    healed = all(stores[i].snapshot() == dict(shards_list[i])
+                 for i in range(len(stores)))
+    if not (healed and all(r.crc_verified for r in rep.reports)):
+        raise SystemExit("perf_dump: repair scenario failed to heal "
+                         "(bug, not a telemetry problem)")
+
+
+def run_recovery_scenario(seed: int, objects: int, clock=None) -> None:
+    """Seeded recovery-churn pass (the acceptance scenario's second
+    half): the epoch-aware orchestrator heals under MapChurn, so the
+    fence/replan/regroup and journal counters take real values."""
+    from ceph_tpu.chaos import MapChurn, ShardErasure, inject
+    from ceph_tpu.crush import (CrushBuilder, step_chooseleaf_indep,
+                                step_emit, step_take)
+    from ceph_tpu.crush.osdmap import OSDMap, PGPool
+    from ceph_tpu.recovery import healed, recover_to_completion
+
+    ec, sinfo, n, shards_list, hinfos = _build_objects(seed, objects)
+    stores = []
+    for i, shards in enumerate(shards_list):
+        store, _ = inject(shards, [ShardErasure(shards=[i % n])],
+                          seed=seed + i, chunk_size=sinfo.chunk_size)
+        stores.append(store)
+    b = CrushBuilder()
+    root = b.build_two_level(n + 3, 2)
+    b.add_rule(0, [step_take(root),
+                   step_chooseleaf_indep(n, b.type_id("host")),
+                   step_emit()])
+    osdmap = OSDMap(crush=b.map)
+    osdmap.pools[1] = PGPool(pool_id=1, pg_num=16, size=n, erasure=True)
+    churn = MapChurn(seed=seed, max_down=1, fire_every=2,
+                     stages=("dispatch",))
+    kw = {"churn": churn}
+    if clock is not None:
+        kw["clock"] = clock
+    rep = recover_to_completion(sinfo, ec, osdmap, 1, 9, stores,
+                                hinfos, **kw)
+    if not (rep.converged and healed(stores, shards_list)):
+        raise SystemExit("perf_dump: recovery scenario failed to "
+                         "converge (bug, not a telemetry problem)")
+
+
+def check_overhead(threshold_pct: float, reps: int = 5) -> dict:
+    """Instrumentation overhead on the host-path bench row
+    (rs_k8_m3_degraded_e1 shape): run the row ``reps`` times with
+    telemetry recording ON and OFF, compare the min elapsed of each
+    (min-of-N is robust to scheduler noise where mean is not)."""
+    from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+
+    argv = ["--plugin", "jerasure",
+            "--parameter", "technique=reed_sol_van",
+            "--parameter", "k=8", "--parameter", "m=3",
+            "--size", str(1 << 18), "--workload", "degraded",
+            "--device", "host", "--batch", "2",
+            "--iterations", "3", "-e", "1"]
+
+    def one_run() -> float:
+        bench = ErasureCodeBench()
+        bench.setup(list(argv))
+        return bench.run()["seconds"]
+
+    one_run()  # warm every cache before either series
+    times = {True: [], False: []}
+    for _ in range(reps):
+        for on in (True, False):
+            telemetry.set_enabled(on)
+            t0 = time.perf_counter()
+            one_run()
+            times[on].append(time.perf_counter() - t0)
+    telemetry.set_enabled(True)
+    t_on, t_off = min(times[True]), min(times[False])
+    overhead = max(0.0, (t_on - t_off) / t_off * 100.0)
+    return {"enabled_s": t_on, "disabled_s": t_off,
+            "overhead_pct": round(overhead, 3),
+            "threshold_pct": threshold_pct,
+            "ok": overhead <= threshold_pct}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="repair",
+                    choices=["repair", "recovery-churn", "both",
+                             "none"],
+                    help="seeded workload to run before dumping "
+                         "(none: dump whatever the process already "
+                         "recorded)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--objects", type=int, default=6)
+    ap.add_argument("--format", default="json",
+                    choices=["json", "prom", "both"])
+    ap.add_argument("--indent", type=int, default=None)
+    ap.add_argument("--validate", action="store_true",
+                    help="validate the dump against the telemetry "
+                         "JSON schema (rc 1 on failure)")
+    ap.add_argument("--fake-clock", action="store_true",
+                    help="drive spans/metrics/scenario with one "
+                         "FakeClock — the dump becomes byte-identical "
+                         "across runs (the determinism demo)")
+    ap.add_argument("--check-overhead", type=float, default=None,
+                    metavar="PCT",
+                    help="measure instrumentation overhead on the "
+                         "host-path bench row; rc 3 if above PCT")
+    args = ap.parse_args(argv)
+
+    if args.check_overhead is not None:
+        res = check_overhead(args.check_overhead)
+        print(json.dumps(res))
+        return 0 if res["ok"] else 3
+
+    clock = None
+    if args.fake_clock:
+        from ceph_tpu.utils.retry import FakeClock
+        clock = FakeClock()
+        telemetry.set_global_tracer(
+            telemetry.SpanTracer(clock=clock, annotate=False))
+        telemetry.set_global_metrics(
+            telemetry.MetricsRegistry(clock=clock))
+    else:
+        telemetry.install_compile_monitor()
+    telemetry.reset_all()
+    if args.scenario in ("repair", "both"):
+        run_repair_scenario(args.seed, args.objects, clock=clock)
+    if args.scenario in ("recovery-churn", "both"):
+        run_recovery_scenario(args.seed, args.objects, clock=clock)
+
+    dump = telemetry.dump_all()
+    if args.validate:
+        errors = telemetry.validate_dump(dump)
+        if errors:
+            for e in errors:
+                print(f"schema: {e}", file=sys.stderr)
+            return 1
+    if args.format in ("json", "both"):
+        print(json.dumps(dump, sort_keys=True, indent=args.indent))
+    if args.format in ("prom", "both"):
+        sys.stdout.write(telemetry.global_metrics().to_prometheus())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,4 +35,13 @@ python tools/recovery_demo.py --erasures 1 --corruptions 1 --churn 3 \
     --crash-site writeback.after_write --torn >/dev/null || exit 1
 python tools/recovery_demo.py --erasures 3 --churn 0 >/dev/null 2>&1
 [ $? -eq 2 ] || { echo "recovery_demo: expected unrecoverable rc 2"; exit 1; }
+# Telemetry gate (ISSUE 6 / docs/OBSERVABILITY.md): a seeded repair +
+# recovery-churn scenario must produce a schema-valid unified dump
+# (spans + metrics; byte-identical under --fake-clock, which the
+# tier-1 tests pin), and instrumentation overhead on the host-path
+# bench row must stay under 3%.
+python tools/perf_dump.py --scenario both --fake-clock --validate \
+    >/dev/null || { echo "perf_dump: telemetry schema gate failed"; exit 1; }
+python tools/perf_dump.py --check-overhead 3 \
+    || { echo "perf_dump: instrumentation overhead above 3%"; exit 1; }
 CEPH_TPU_FULL=1 exec python -m pytest tests/ -q "$@"
