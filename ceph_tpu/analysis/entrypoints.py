@@ -45,9 +45,11 @@ from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
 
 # the five plugin families + the engine/ops/crush/scrub surfaces the
 # acceptance gate requires coverage for, plus the telemetry plane
-# (host-tier: its whole contract is "compiles nothing, ever")
+# (host-tier: its whole contract is "compiles nothing, ever") and the
+# serving front-end (jit tier: the bucketed dispatch program; host
+# tier: queue/batcher bookkeeping)
 FAMILIES = ("jerasure", "isa", "shec", "lrc", "clay",
-            "engine", "ops", "crush", "scrub", "telemetry")
+            "engine", "ops", "crush", "scrub", "telemetry", "serve")
 
 # public device surfaces a plugin family can expose; the completeness
 # check requires every one present on a family's representative
@@ -349,6 +351,54 @@ def _build_crc_batch() -> Built:
     return Built(ceph_crc32c_batch, (crcs, bufs), ceph_crc32c_batch)
 
 
+def _build_serve_dispatch() -> Built:
+    """The serving batcher's bucketed device dispatch
+    (engine.serve_dispatch_call): the jitted per-(plugin, profile, op,
+    pattern) program a shape bucket fires.  Traced on the
+    representative RS encode bucket at a mid-ladder rung — the audit
+    certifies the program shape; the zero-warm-recompile property over
+    a full request stream is pinned by tests/test_serve.py on top of
+    this entry's warm == 0 sentinel."""
+    import numpy as np
+
+    from ..codes.engine import serve_dispatch_call
+
+    ec = representative_instance("jerasure")
+    k = ec.get_data_chunk_count()
+    fn = serve_dispatch_call(ec, "encode")
+    return Built(fn, (np.zeros((4, k, C), np.uint8),),
+                 serve_dispatch_call)
+
+
+def _build_serve_batcher() -> Built:
+    """Queue/batcher/SLO bookkeeping as a host-tier entry: a seeded
+    closed-loop mini-scenario on a FakeClock with the host executor
+    runs admission → bucketing → deadline-slack firing → SLO report
+    end to end and must trigger ZERO jax compiles and return zero
+    device arrays — the serving front door stays host bookkeeping by
+    construction."""
+    from ..serve.batcher import ContinuousBatcher
+    from ..serve.loadgen import (CodecSpec, TrafficSpec,
+                                 run_serving_scenario,
+                                 throughput_service_model)
+    from ..utils.retry import FakeClock
+
+    spec = TrafficSpec(
+        seed=11, n_requests=12,
+        codecs=[CodecSpec("rs_k2_m1", "jerasure",
+                          {"technique": "reed_sol_van",
+                           "k": "2", "m": "1"}, 512)],
+        ladder=(1, 2, 4), concurrency=6)
+
+    def workload():
+        run = run_serving_scenario(
+            spec, clock=FakeClock(), executor="host",
+            service_model=throughput_service_model())
+        return run.report
+
+    return Built(workload, (), ContinuousBatcher.poll)
+
+
 def _build_telemetry() -> Built:
     """The telemetry plane as a host-tier entry: spans + histograms +
     registry + both exporters run end to end (telemetry_selftest) and
@@ -422,6 +472,11 @@ def registry() -> Tuple[EntryPoint, ...]:
                    _build_crc_batch, allow=None, trace_budget=0),
         EntryPoint("telemetry.selftest", "telemetry", "host",
                    _build_telemetry, allow=None, trace_budget=0),
+        EntryPoint("serve.dispatch", "serve", "jit",
+                   _build_serve_dispatch, allow=GF_XLA_PRIMS,
+                   trace_budget=16),
+        EntryPoint("serve.batcher", "serve", "host",
+                   _build_serve_batcher, allow=None, trace_budget=0),
     ]
     return tuple(entries)
 

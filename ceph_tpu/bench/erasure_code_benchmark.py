@@ -131,7 +131,8 @@ class ErasureCodeBench:
                         help="erasure code plugin name")
         ap.add_argument("-w", "--workload", default="encode",
                         choices=["encode", "decode", "degraded",
-                                 "repair-batched", "recovery-churn"])
+                                 "repair-batched", "recovery-churn",
+                                 "serving"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -152,6 +153,14 @@ class ErasureCodeBench:
                              "reweight epoch every K pattern-batch "
                              "dispatches (0 disables churn — the "
                              "still-map control number)")
+        ap.add_argument("--requests", type=int, default=256,
+                        help="serving workload: requests in the "
+                             "seeded mixed stream (the canonical "
+                             "rs/shec/clay mix — --plugin/-P do not "
+                             "apply to this workload)")
+        ap.add_argument("--concurrency", type=int, default=64,
+                        help="serving workload: closed-loop in-flight "
+                             "window")
         ap.add_argument("-E", "--erasures-generation", default="random",
                         choices=["random", "exhaustive"], dest="erasures_generation")
         ap.add_argument("--erased", action="append", type=int, default=None,
@@ -203,6 +212,11 @@ class ErasureCodeBench:
             ap.error(f"--iterations {self.args.iterations} must be >= 1")
         if self.args.batch < 1:
             ap.error(f"--batch {self.args.batch} must be >= 1")
+        if self.args.requests < 1:
+            ap.error(f"--requests {self.args.requests} must be >= 1")
+        if self.args.concurrency < 1:
+            ap.error(f"--concurrency {self.args.concurrency} "
+                     f"must be >= 1")
         if self.args.layout == "packed" and not (
                 self.args.loop and self.args.device == "jax"):
             ap.error("--layout packed applies to the --loop "
@@ -859,6 +873,50 @@ class ErasureCodeBench:
         res["device_calls"] = rep.device_calls
         return res
 
+    # -- serving (the ragged continuous-batching front-end: a seeded
+    # mixed request stream through serve/ — ROADMAP item 3) -------------
+
+    def serving(self) -> dict:
+        """Tail-latency serving numbers: the canonical mixed
+        rs/shec/clay stream (serve.loadgen.default_spec, seeded by
+        --seed) driven closed-loop through the admission queue and the
+        continuous batcher on the REAL clock.  The row reports
+        GB/s-under-SLO (only bytes of requests that met their
+        deadline), request-latency p50/p99/p999, deadline-miss rate
+        and padding overhead — the axes offline GB/s cannot see.  The
+        stream is byte-verified against the generator's ground truth
+        and, on the jax path, carries the post-warmup backend-compile
+        count (0 = the zero-warm-recompile contract held)."""
+        from ..serve import (default_spec, run_serving_scenario,
+                             verify_results)
+        a = self.args
+        executor = "device" if a.device == "jax" else "host"
+        spec = default_spec(seed=a.seed, n_requests=a.requests,
+                            stripe_size=a.size, erasures=a.erasures,
+                            arrival="closed")
+        spec.concurrency = a.concurrency
+        run = run_serving_scenario(spec, executor=executor)
+        bad = verify_results(run.results)
+        if bad:
+            raise RuntimeError(
+                f"serving stream corrupted: {len(bad)} request(s) "
+                f"differ from ground truth (ids {bad[:8]})")
+        rep = run.report
+        res = self._result("serving", rep["elapsed_s"], rep["bytes"])
+        res["lat_p50_ms"] = rep["p50_ms"]
+        res["lat_p99_ms"] = rep["p99_ms"]
+        res["lat_p999_ms"] = rep["p999_ms"]
+        res["lat_samples"] = rep["requests"]
+        res["gbps_under_slo"] = rep["gbps_under_slo"]
+        res["deadline_miss_rate"] = rep["deadline_miss_rate"]
+        res["padding_overhead"] = rep["padding"]["padding_overhead"]
+        res["requests"] = rep["requests"]
+        res["rejected"] = rep["rejected"]
+        res["dispatches"] = rep["padding"]["dispatches"]
+        res["stream_compiles"] = rep.get("stream_compiles")
+        res["op_classes"] = rep["op_classes"]
+        return res
+
     def _run_workload(self) -> dict:
         if self.args.workload == "encode":
             return self.encode()
@@ -868,6 +926,8 @@ class ErasureCodeBench:
             return self.repair_batched()
         if self.args.workload == "recovery-churn":
             return self.recovery_churn()
+        if self.args.workload == "serving":
+            return self.serving()
         return self.decode()
 
 

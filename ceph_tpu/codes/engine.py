@@ -231,3 +231,61 @@ def fused_repair_call(ec, available: Tuple[int, ...],
         return timed
 
     return global_pattern_cache().get_or_build(key, build)
+
+
+# -- serving dispatch seam (serve/batcher.py's one device call) ---------
+
+def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
+                        erased: Tuple[int, ...] = ()):
+    """One cached, jitted program per (plugin, profile, op, erasure
+    pattern): the seam the continuous batcher (serve/batcher.py) fires
+    its shape buckets through.
+
+    The cache key is :func:`pattern_key` with ``kind=f"serve-{op}"`` —
+    the SAME keying the decode-matrix and fused-repair artifacts use,
+    so a serving bucket and a scrub repair plan for the same pattern
+    share the composite matrices underneath, and steady-state traffic
+    over a warmed bucket ladder compiles NOTHING (the armed recompile
+    budget turns violations into a loud RuntimeError; the tpu-audit
+    sentinel on ``serve.dispatch`` pins warm == 0 compiles forever).
+
+    - ``encode``: stack ``(B, k, C)`` uint8 → parity ``(B, m, C)``
+    - ``decode``: stack ``(B, n_avail, C)`` survivors → ``(B, n_erased,
+      C)`` reconstructed chunks
+    - ``repair``: delegates to :func:`fused_repair_call` — the batcher
+      reuses the scrub path's decode→re-encode program (and its cache
+      entry) verbatim.
+    """
+    if op == "repair":
+        return fused_repair_call(ec, available, erased)
+    if op not in ("encode", "decode"):
+        raise ValueError(f"serve op {op!r} must be encode|decode|repair")
+    import jax
+
+    available = tuple(available)
+    erased = tuple(erased)
+    key = pattern_key(ec, f"serve-{op}", available, erased)
+
+    def build():
+        if op == "encode":
+            @jax.jit
+            def fn(stack):
+                return ec.encode_chunks_jax(stack)
+        else:
+            @jax.jit
+            def fn(stack):
+                return ec.decode_chunks_jax(stack, available, erased)
+
+        def timed(stack):
+            # same trace-eagerness discipline as fused_repair_call:
+            # record nothing when WE are being traced into a larger
+            # program, so jaxprs stay telemetry-free
+            with tel.record_dispatch(
+                    "serve_dispatch",
+                    eager=not isinstance(stack, jax.core.Tracer),
+                    op=op, plugin=type(ec).__name__):
+                return fn(stack)
+
+        return timed
+
+    return global_pattern_cache().get_or_build(key, build)

@@ -1,0 +1,421 @@
+"""Continuous shape-bucketed batcher — ragged request streams onto
+fixed-shape device programs, with zero warm recompiles.
+
+The data plane underneath (codes/engine.py + ops/) runs ONE jitted
+program per (plugin, profile, op, erasure pattern, array shape).  A
+ragged serving stream therefore has exactly one efficient mapping onto
+it, the Ragged-Paged-Attention discipline (PAPERS.md, arxiv
+2604.15464) translated to erasure coding:
+
+- **Shape buckets.**  Requests coalesce into buckets keyed EXACTLY
+  like the PatternCache — (plugin class, profile, serve-op kind,
+  available, erased) via :func:`~ceph_tpu.codes.engine.pattern_key`,
+  extended with the chunk size — so bucket identity ≡ device-program
+  identity and a warm bucket can never trace a new program.
+- **The rung ladder.**  The batch dimension is padded up to a small
+  fixed ladder (default 1/4/16/64) instead of dispatching every
+  occupancy as its own shape: |ladder| programs per bucket, warmed
+  once, reused forever.  Padding waste is counted per dispatch
+  (``serve_padded_stripes`` / ``serve_padding_bytes``) — the SLO
+  report carries the overhead ratio, because padding is the price of
+  shape stability and must stay visible.
+- **Deadline-aware firing.**  A bucket fires when it reaches the top
+  rung (full) OR when its oldest request's slack — deadline minus now
+  minus the bucket's EWMA service estimate — runs out.  Under load
+  batches fill; under trickle traffic nobody waits past their
+  deadline for co-batchees that never come.
+
+Execution goes through :func:`~ceph_tpu.codes.engine.serve_dispatch_call`
+(``executor="device"``; repair reuses the scrub path's fused
+decode→re-encode program and cache entry) or the plugins' numpy batch
+surfaces (``executor="host"`` — byte-identical by the cross-pinning in
+tests/, and the zero-compile tier the ``serve.batcher`` host audit
+entry runs).  Every dispatch is demuxed back to per-request
+:class:`~ceph_tpu.serve.queue.EcResult`\\ s; padded rows are dropped on
+the host side, so batched results are byte-identical to per-request
+execution by construction (pinned for all five plugin families in
+tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..telemetry import metrics as tel
+from ..telemetry import span
+from ..utils.log import dout
+from .queue import AdmissionQueue, EcRequest, EcResult
+
+# padded stripe-batch sizes: every dispatch shape's batch dim is one
+# of these, so steady-state traffic holds |ladder| programs per bucket
+LADDER = (1, 4, 16, 64)
+
+# EWMA smoothing for the per-bucket service-time estimate
+_EWMA_ALPHA = 0.3
+
+# floor on the service estimate (seconds): a fresh bucket with no
+# dispatch history must still fire BEFORE its deadline by enough to
+# land the dispatch — with a zero estimate it would fire exactly at
+# the deadline and the service time would push every completion past
+# it (found by the first FakeClock scenario run)
+_MIN_SLACK = 1e-3
+
+
+def rung_for(n: int, ladder: Tuple[int, ...]) -> int:
+    """Smallest ladder rung holding ``n`` requests."""
+    for r in ladder:
+        if n <= r:
+            return r
+    raise ValueError(f"occupancy {n} exceeds top rung {ladder[-1]}")
+
+
+class _Bucket:
+    """One shape bucket: same plugin/profile/op/pattern/chunk-size —
+    same device program family."""
+
+    __slots__ = ("key", "ec", "op", "available", "erased", "chunk_size",
+                 "rows", "requests")
+
+    def __init__(self, key, ec, op, available, erased, chunk_size,
+                 rows) -> None:
+        self.key = key
+        self.ec = ec
+        self.op = op
+        self.available = available
+        self.erased = erased
+        self.chunk_size = chunk_size
+        self.rows = rows
+        self.requests: List[EcRequest] = []
+
+    @property
+    def oldest_deadline(self) -> float:
+        return min(r.deadline for r in self.requests)
+
+
+class ContinuousBatcher:
+    """Coalesce an admission queue into shape-bucketed dispatches.
+
+    ``executor``: ``"device"`` fires the jitted
+    ``serve_dispatch_call`` programs; ``"host"`` runs the numpy batch
+    surfaces (plugin instances pinned off the XLA path) — the
+    zero-compile bookkeeping tier.
+
+    ``service_model``: optional ``(bucket, rung) -> seconds``
+    deterministic service-time simulator.  When set, the clock is
+    advanced by the model after each dispatch instead of measuring
+    wall time — a seeded scenario on a FakeClock then produces
+    byte-identical batch compositions AND SLO reports across runs
+    (the determinism contract tests/test_serve.py pins).
+    """
+
+    def __init__(self, clock=None, ladder: Tuple[int, ...] = LADDER,
+                 executor: str = "device",
+                 service_model: Optional[Callable] = None,
+                 min_slack: float = _MIN_SLACK) -> None:
+        from ..utils.retry import SystemClock
+
+        if executor not in ("device", "host"):
+            raise ValueError(f"executor {executor!r} must be "
+                             f"device|host")
+        if tuple(ladder) != tuple(sorted(set(ladder))):
+            raise ValueError(f"ladder {ladder} must be strictly "
+                             f"increasing")
+        self.clock = clock if clock is not None else SystemClock()
+        self.ladder = tuple(ladder)
+        self.executor = executor
+        self.service_model = service_model
+        self.min_slack = min_slack
+        self._instances: Dict[tuple, object] = {}
+        self._buckets: "Dict[tuple, _Bucket]" = {}
+        self._est: Dict[tuple, float] = {}
+        # per-dispatch composition log (bucket key, rung, req ids) —
+        # the byte-identical-replay witness tests and the demo print
+        self.dispatch_log: List[dict] = []
+        self.dispatches = 0
+        self.stripes = 0
+        self.padded_stripes = 0
+        self.padded_bytes = 0
+        self.warmup_dispatches = 0
+
+    # -- plugin instance + bucket resolution ----------------------------
+
+    def _instance(self, plugin: str, profile: Dict[str, str]):
+        pkey = (plugin, tuple(sorted((str(k), str(v))
+                                     for k, v in profile.items())))
+        ec = self._instances.get(pkey)
+        if ec is None:
+            from ..codes.registry import ErasureCodePluginRegistry
+
+            ec = ErasureCodePluginRegistry.instance().factory(
+                plugin, dict(profile))
+            if self.executor == "host":
+                # pin the numpy reference path: the host tier must
+                # never dispatch through jax at any batch size
+                ec.min_xla_bytes = float("inf")
+            self._instances[pkey] = ec
+        return ec
+
+    def bucket_key(self, req: EcRequest) -> tuple:
+        """The bucket identity — the PatternCache key of the program
+        the bucket will fire, extended with the chunk size (the only
+        shape axis the pattern alone doesn't fix)."""
+        from ..codes.engine import pattern_key
+
+        ec = self._instance(req.plugin, req.profile)
+        chunk = ec.get_chunk_size(req.stripe_size)
+        return pattern_key(ec, f"serve-{req.op}", req.available,
+                           req.erased, extra=(chunk,))
+
+    def _bucket_for(self, req: EcRequest) -> _Bucket:
+        key = self.bucket_key(req)
+        b = self._buckets.get(key)
+        if b is None:
+            ec = self._instance(req.plugin, req.profile)
+            chunk = ec.get_chunk_size(req.stripe_size)
+            rows = (ec.get_data_chunk_count() if req.op == "encode"
+                    else len(req.available))
+            b = self._buckets[key] = _Bucket(
+                key, ec, req.op, req.available, req.erased, chunk, rows)
+        return b
+
+    # -- admission -------------------------------------------------------
+
+    def admit(self, requests: List[EcRequest]) -> List[EcResult]:
+        """Classify requests into buckets; a bucket reaching the top
+        rung fires immediately (continuous batching — full buckets
+        never wait for the next poll)."""
+        results: List[EcResult] = []
+        for req in requests:
+            b = self._bucket_for(req)
+            want = (b.rows, b.chunk_size)
+            if tuple(req.payload.shape) != want:
+                raise ValueError(
+                    f"request {req.req_id}: payload shape "
+                    f"{tuple(req.payload.shape)} != {want} for "
+                    f"op={req.op} plugin={req.plugin}")
+            b.requests.append(req)
+            if len(b.requests) >= self.ladder[-1]:
+                results += self._fire(b)
+        return results
+
+    # -- deadline-aware firing ------------------------------------------
+
+    def est_service(self, key: tuple) -> float:
+        """EWMA service-time estimate for the bucket's dispatches
+        (seeded by the timed warmup dispatch, 0.0 for a bucket that
+        never warmed)."""
+        return self._est.get(key, 0.0)
+
+    def _margin(self, key: tuple) -> float:
+        """How far BEFORE its deadline a bucket must fire: twice the
+        service estimate plus the floor.  Firing at exactly
+        ``deadline - est`` puts every completion on the knife edge
+        (any estimate error = a miss); the 2x margin lands the
+        completion ~one service time early instead."""
+        return 2.0 * self.est_service(key) + self.min_slack
+
+    def _due(self, b: _Bucket, now: float) -> bool:
+        if not b.requests:
+            return False
+        return b.oldest_deadline - now - self._margin(b.key) <= 0.0
+
+    def poll(self, queue: Optional[AdmissionQueue] = None
+             ) -> List[EcResult]:
+        """One batcher turn: drain the queue, fire full buckets, then
+        fire every bucket whose oldest request's slack has run out —
+        earliest deadline first, so a tight-deadline bucket never
+        queues behind a lazy one."""
+        results: List[EcResult] = []
+        if queue is not None:
+            results += self.admit(queue.drain())
+        now = self.clock.monotonic()
+        due = sorted((b for b in self._buckets.values()
+                      if self._due(b, now)),
+                     key=lambda b: b.oldest_deadline)
+        for b in due:
+            results += self._fire(b)
+        return results
+
+    def flush(self) -> List[EcResult]:
+        """Fire every non-empty bucket (end of stream)."""
+        results: List[EcResult] = []
+        for b in sorted((b for b in self._buckets.values()
+                         if b.requests),
+                        key=lambda b: b.oldest_deadline):
+            results += self._fire(b)
+        return results
+
+    def next_wakeup(self) -> Optional[float]:
+        """Earliest absolute time any bucket becomes due (the sim
+        driver advances its FakeClock here when idle)."""
+        times = [b.oldest_deadline - self._margin(b.key)
+                 for b in self._buckets.values() if b.requests]
+        return min(times) if times else None
+
+    def pending(self) -> int:
+        return sum(len(b.requests) for b in self._buckets.values())
+
+    # -- dispatch --------------------------------------------------------
+
+    def _execute(self, b: _Bucket, stack: np.ndarray):
+        """One batched execution: the jitted serve program (device) or
+        the numpy batch surfaces (host).  Returns op-shaped host
+        arrays (device outputs fetched once per batch)."""
+        if self.executor == "device":
+            from ..codes.engine import serve_dispatch_call
+
+            call = serve_dispatch_call(b.ec, b.op, b.available, b.erased)
+            out = call(stack)
+            if b.op == "repair":
+                rec, parity = out
+                return np.asarray(rec), np.asarray(parity)
+            return np.asarray(out)
+        # host tier: numpy end to end
+        if b.op == "encode":
+            return np.asarray(b.ec.encode_chunks_batch(stack))
+        if b.op == "decode":
+            return np.asarray(b.ec.decode_chunks_batch(
+                stack, b.available, b.erased))
+        return _host_repair(b.ec, stack, b.available, b.erased)
+
+    def _fire(self, b: _Bucket) -> List[EcResult]:
+        reqs, b.requests = b.requests, []
+        n = len(reqs)
+        rung = rung_for(n, self.ladder)
+        stack = np.zeros((rung, b.rows, b.chunk_size), np.uint8)
+        for i, r in enumerate(reqs):
+            stack[i] = r.payload
+        t0 = self.clock.monotonic()
+        with span("serve.batch", op=b.op, occupancy=n, rung=rung,
+                  plugin=type(b.ec).__name__):
+            with span("serve.dispatch", executor=self.executor):
+                out = self._execute(b, stack)
+            if self.service_model is not None:
+                # sim mode: deterministic service time instead of wall
+                # time — byte-identical SLO reports from a seed
+                self.clock.sleep(self.service_model(b, rung))
+        t1 = self.clock.monotonic()
+        service = t1 - t0
+        self._est[b.key] = (service if b.key not in self._est else
+                            (1 - _EWMA_ALPHA) * self._est[b.key]
+                            + _EWMA_ALPHA * service)
+        self.dispatches += 1
+        self.stripes += n
+        pad = rung - n
+        self.padded_stripes += pad
+        self.padded_bytes += pad * b.rows * b.chunk_size
+        tel.counter("serve_dispatches", op=b.op)
+        tel.counter("serve_stripes", n, op=b.op)
+        if pad:
+            tel.counter("serve_padded_stripes", pad, op=b.op)
+        tel.observe("serve_batch_occupancy", n, op=b.op)
+        self.dispatch_log.append({
+            "bucket": "|".join(str(p) for p in b.key),
+            "op": b.op, "occupancy": n, "rung": rung,
+            "req_ids": [r.req_id for r in reqs]})
+        results = []
+        for i, r in enumerate(reqs):
+            if b.op == "repair":
+                rec, parity = out
+                payload_out = (rec[i], parity[i])
+            else:
+                payload_out = out[i]
+            wait = t0 - (r.arrival if r.arrival is not None else t0)
+            tel.observe("serve_queue_wait_seconds", max(0.0, wait),
+                        op=b.op)
+            results.append(EcResult(
+                request=r, output=payload_out, completed=t1,
+                queue_wait=max(0.0, wait), service=service,
+                batch_occupancy=n, batch_rung=rung,
+                deadline_met=(r.deadline is None or t1 <= r.deadline)))
+        return results
+
+    # -- warmup ----------------------------------------------------------
+
+    def warmup(self, requests: List[EcRequest]) -> int:
+        """Compile the whole bucket ladder for every distinct bucket
+        the request list will touch: one zero-filled dispatch per
+        (bucket, rung).  After this, a stream drawn from the same mix
+        compiles NOTHING — the armed recompile budget and the compile
+        monitor both stay flat (the acceptance gate's 'zero warm
+        recompiles').  Returns the number of warmup dispatches."""
+        seen = set()
+        fired = 0
+        for req in requests:
+            key = self.bucket_key(req)
+            if key in seen:
+                continue
+            seen.add(key)
+            b = self._bucket_for(req)
+            for rung in self.ladder:
+                zeros = np.zeros((rung, b.rows, b.chunk_size), np.uint8)
+                self._execute(b, zeros)
+                fired += 1
+            # seed the service estimator with a timed WARM dispatch of
+            # the top rung (the first run above paid the compile, so
+            # this measures steady-state service, not trace time) —
+            # deadline-slack firing then has an honest worst-case
+            # estimate before the first real request is at stake.  In
+            # sim mode the model is the estimator; skip the extra
+            # dispatch and don't touch the sim clock.
+            if self.service_model is not None:
+                self._est[key] = self.service_model(b, self.ladder[-1])
+            else:
+                top = np.zeros((self.ladder[-1], b.rows, b.chunk_size),
+                               np.uint8)
+                t0 = self.clock.monotonic()
+                self._execute(b, top)
+                self._est[key] = self.clock.monotonic() - t0
+                fired += 1
+        self.warmup_dispatches += fired
+        if fired:
+            tel.counter("serve_warmup_dispatches", fired)
+            dout("serve", 10,
+                 f"warmed {len(seen)} buckets x {len(self.ladder)} "
+                 f"rungs ({fired} dispatches)")
+        return fired
+
+    # -- accounting ------------------------------------------------------
+
+    def padding_stats(self) -> dict:
+        total = self.stripes + self.padded_stripes
+        return {
+            "dispatches": self.dispatches,
+            "stripes": self.stripes,
+            "padded_stripes": self.padded_stripes,
+            "padded_bytes": self.padded_bytes,
+            "padding_overhead": (round(self.padded_stripes / total, 6)
+                                 if total else 0.0),
+            "warmup_dispatches": self.warmup_dispatches,
+        }
+
+
+def _host_repair(ec, stack: np.ndarray, available: Tuple[int, ...],
+                 erased: Tuple[int, ...]):
+    """Numpy mirror of engine.fused_repair_call: decode the erased
+    shards, assemble the data chunks from survivor and decoded columns
+    by static index, re-encode the full parity set.  Byte-identical to
+    the fused device program by construction (same surfaces, same
+    column assembly)."""
+    from ..codes.stripe import _chunk_mapping
+
+    rec = np.asarray(ec.decode_chunks_batch(stack, available, erased))
+    mapping = _chunk_mapping(ec)
+    aidx = {s: t for t, s in enumerate(available)}
+    eidx = {s: t for t, s in enumerate(erased)}
+    cols = []
+    for c in range(ec.get_data_chunk_count()):
+        shard = mapping[c]
+        if shard in aidx:
+            cols.append(stack[:, aidx[shard], :])
+        elif shard in eidx:
+            cols.append(rec[:, eidx[shard], :])
+        else:
+            raise IOError(
+                f"data shard {shard} neither available nor erased "
+                f"(avail={available}, erased={erased})")
+    data = np.stack(cols, axis=1)
+    parity = np.asarray(ec.encode_chunks_batch(data))
+    return rec, parity

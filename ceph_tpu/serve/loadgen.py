@@ -1,0 +1,391 @@
+"""Seeded traffic generation + the closed/open-loop scenario driver.
+
+``LoadGenerator`` turns a declarative :class:`TrafficSpec` — codec
+mix, op mix, stripe sizes, arrival process, per-op deadlines — into a
+deterministic request stream: same seed ⇒ the same requests with the
+same payload bytes, the same erasure patterns, the same arrival
+offsets, forever.  Ground truth (``expect``) rides every request, so
+any consumer can verify served bytes against the encode that produced
+them.
+
+``run_serving_scenario`` is THE driver every consumer shares (bench
+``--workload serving``, tools/serve_demo.py, tests/test_serve.py):
+queue → batcher → SLO recorder wired on one injectable clock.
+
+- **closed loop**: a fixed concurrency window; a completion admits the
+  next request (the classic closed-loop load generator — measures the
+  system at a stable occupancy).
+- **open loop**: seeded-Poisson arrival offsets replayed on the clock
+  regardless of completions (arrival-rate pressure; queue waits and
+  rejections are the signal).
+
+With a FakeClock + a deterministic ``service_model`` the whole run is
+a simulation: batch compositions, latencies and the SLO report are
+byte-identical across runs from one seed (tests pin this).  With the
+real clock and no model, latencies are wall-clock truth — that is the
+bench configuration.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .batcher import LADDER, ContinuousBatcher
+from .queue import AdmissionQueue, EcRequest, EcResult
+from .sla import SlaRecorder, SloPolicy
+
+# advance floor when the sim clock would otherwise stall (a due event
+# exactly at `now` always makes progress on the next poll)
+_TICK = 1e-4
+
+
+@dataclass(frozen=True)
+class CodecSpec:
+    """One (plugin, profile, stripe size) the mix draws from."""
+
+    name: str
+    plugin: str
+    profile: Dict[str, str]
+    stripe_size: int
+    weight: float = 1.0
+
+
+@dataclass
+class TrafficSpec:
+    """Declarative serving scenario (replayable from ``seed``)."""
+
+    seed: int = 42
+    n_requests: int = 256
+    codecs: List[CodecSpec] = field(default_factory=list)
+    op_mix: Dict[str, float] = field(
+        default_factory=lambda: {"encode": 0.5, "decode": 0.35,
+                                 "repair": 0.15})
+    deadlines: Dict[str, float] = field(
+        default_factory=lambda: {"encode": 0.2, "decode": 0.2,
+                                 "repair": 0.5})
+    arrival: str = "closed"          # "closed" | "open"
+    rate: float = 2000.0             # open loop: mean req/s (Poisson)
+    concurrency: int = 64            # closed loop: in-flight window
+    erasures: int = 1
+    ladder: Tuple[int, ...] = LADDER
+    queue_capacity: int = 4096
+    pool: int = 8                    # distinct stripes per codec
+
+    def __post_init__(self) -> None:
+        if self.arrival not in ("closed", "open"):
+            raise ValueError(f"arrival {self.arrival!r} must be "
+                             f"closed|open")
+        if not self.codecs:
+            raise ValueError("spec needs at least one CodecSpec")
+
+
+def default_spec(seed: int = 42, n_requests: int = 256,
+                 stripe_size: int = 1 << 16,
+                 arrival: str = "closed",
+                 erasures: int = 1, **overrides) -> TrafficSpec:
+    """The canonical mixed scenario: RS + shec + clay, encode-heavy
+    with a decode/repair tail — the bench serving row and the demo
+    both run this shape."""
+    codecs = [
+        CodecSpec("rs_k8_m3", "jerasure",
+                  {"technique": "reed_sol_van", "k": "8", "m": "3"},
+                  stripe_size, weight=3.0),
+        CodecSpec("shec_k6_m3_c2", "shec",
+                  {"k": "6", "m": "3", "c": "2"}, stripe_size,
+                  weight=2.0),
+        CodecSpec("clay_k8_m4_d11", "clay",
+                  {"k": "8", "m": "4", "d": "11"}, stripe_size,
+                  weight=1.0),
+    ]
+    return TrafficSpec(seed=seed, n_requests=n_requests, codecs=codecs,
+                       arrival=arrival, erasures=erasures, **overrides)
+
+
+# ----------------------------------------------------------------------
+# generation
+
+class _CodecState:
+    """Prepared per-codec material: plugin instance, a pool of
+    encoded stripes, and the decodable erasure patterns the stream
+    draws from."""
+
+    def __init__(self, codec: CodecSpec, seed: int,
+                 erasures: int, pool: int) -> None:
+        from ..codes.registry import ErasureCodePluginRegistry
+
+        self.codec = codec
+        ec = ErasureCodePluginRegistry.instance().factory(
+            codec.plugin, dict(codec.profile))
+        # payload prep is host bookkeeping: never let it dispatch
+        # through jax (the generator must stay compile-free)
+        ec.min_xla_bytes = float("inf")
+        self.ec = ec
+        self.k = ec.get_data_chunk_count()
+        self.n = ec.get_chunk_count()
+        self.chunk = ec.get_chunk_size(codec.stripe_size)
+        rng = np.random.default_rng(seed)
+        self.data = rng.integers(0, 256, (pool, self.k, self.chunk),
+                                 dtype=np.uint8)
+        self.parity = np.asarray(ec.encode_chunks_batch(self.data))
+        # place data/parity at their global shard positions (lrc
+        # scatters data; everything else is identity)
+        mapping = ec.get_chunk_mapping()
+        data_pos = list(mapping) if mapping else list(range(self.k))
+        parity_pos = [p for p in range(self.n)
+                      if p not in set(data_pos)]
+        self.allchunks = np.empty((pool, self.n, self.chunk), np.uint8)
+        self.allchunks[:, data_pos] = self.data
+        self.allchunks[:, parity_pos] = self.parity
+        self.patterns = self._decodable_patterns(erasures)
+
+    def _decodable_patterns(self, erasures: int,
+                            cap: int = 8) -> List[tuple]:
+        pats = []
+        for combo in itertools.combinations(range(self.n), erasures):
+            try:
+                self.ec.minimum_to_decode(
+                    set(combo), set(range(self.n)) - set(combo))
+            except IOError:
+                continue
+            pats.append(combo)
+            if len(pats) >= cap:
+                break
+        if not pats:
+            raise IOError(
+                f"{self.codec.name}: no decodable {erasures}-erasure "
+                f"pattern (k={self.k}, n={self.n})")
+        return pats
+
+
+class LoadGenerator:
+    """Deterministic request-stream factory for a TrafficSpec."""
+
+    def __init__(self, spec: TrafficSpec) -> None:
+        self.spec = spec
+        self.states = [
+            _CodecState(c, seed=spec.seed + 7919 * i,
+                        erasures=spec.erasures, pool=spec.pool)
+            for i, c in enumerate(spec.codecs)]
+
+    def generate(self) -> Tuple[List[EcRequest], Optional[List[float]]]:
+        """(requests, arrival offsets).  Offsets are cumulative
+        seconds from stream start for open-loop arrival, None for
+        closed loop.  Request ids are 0..n-1 (stream order) so two
+        runs of one seed log identical batch compositions."""
+        spec = self.spec
+        rng = np.random.default_rng(spec.seed)
+        ops = sorted(spec.op_mix)
+        opw = np.array([spec.op_mix[o] for o in ops], dtype=float)
+        opw = opw / opw.sum()
+        cw = np.array([c.weight for c in spec.codecs], dtype=float)
+        cw = cw / cw.sum()
+        reqs: List[EcRequest] = []
+        for i in range(spec.n_requests):
+            st = self.states[int(rng.choice(len(self.states), p=cw))]
+            op = ops[int(rng.choice(len(ops), p=opw))]
+            j = int(rng.integers(st.data.shape[0]))
+            reqs.append(self._make(st, op, j,
+                                   int(rng.integers(len(st.patterns))),
+                                   req_id=i))
+        offsets = None
+        if spec.arrival == "open":
+            gaps = rng.exponential(1.0 / spec.rate,
+                                   size=spec.n_requests)
+            offsets = list(np.cumsum(gaps))
+        return reqs, offsets
+
+    def _make(self, st: _CodecState, op: str, j: int, pat_idx: int,
+              req_id: int) -> EcRequest:
+        codec = st.codec
+        work = st.k * st.chunk
+        if op == "encode":
+            return EcRequest(
+                op=op, plugin=codec.plugin, profile=codec.profile,
+                stripe_size=codec.stripe_size,
+                payload=st.data[j].copy(), req_id=req_id,
+                work_bytes=work, expect=st.parity[j])
+        erased = st.patterns[pat_idx]
+        available = tuple(x for x in range(st.n) if x not in erased)
+        survivors = np.ascontiguousarray(
+            st.allchunks[j, list(available), :])
+        rec_expect = st.allchunks[j, list(erased), :]
+        expect = (rec_expect if op == "decode"
+                  else (rec_expect, st.parity[j]))
+        return EcRequest(
+            op=op, plugin=codec.plugin, profile=codec.profile,
+            stripe_size=codec.stripe_size, payload=survivors,
+            available=available, erased=erased, req_id=req_id,
+            work_bytes=work, expect=expect)
+
+
+# ----------------------------------------------------------------------
+# verification + service models
+
+def verify_results(results: List[EcResult]) -> List[int]:
+    """Request ids whose served output differs from the generator's
+    ground truth (empty = byte-identical stream)."""
+    bad = []
+    for res in results:
+        exp = res.request.expect
+        if exp is None:
+            continue
+        if res.request.op == "repair":
+            rec, parity = res.output
+            ok = (np.array_equal(rec, exp[0])
+                  and np.array_equal(parity, exp[1]))
+        else:
+            ok = np.array_equal(res.output, exp)
+        if not ok:
+            bad.append(res.request.req_id)
+    return bad
+
+
+def throughput_service_model(gbps: float = 10.0,
+                             overhead_s: float = 2e-4):
+    """Deterministic sim service time: dispatch overhead plus padded
+    bytes over a modeled device bandwidth (FakeClock scenarios)."""
+
+    def model(bucket, rung: int) -> float:
+        nbytes = rung * bucket.rows * bucket.chunk_size
+        return overhead_s + nbytes / (gbps * 1e9)
+
+    return model
+
+
+# ----------------------------------------------------------------------
+# THE scenario driver
+
+@dataclass
+class ServingRun:
+    """One scenario's artifacts: per-request results, the SLO report,
+    and the live queue/batcher for deeper inspection."""
+
+    results: List[EcResult]
+    report: dict
+    queue: AdmissionQueue
+    batcher: ContinuousBatcher
+    stream_compiles: Optional[int] = None
+
+
+def _device_compiles() -> int:
+    from ..telemetry import global_metrics
+
+    return global_metrics().counter_value("jax_backend_compiles")
+
+
+def run_serving_scenario(spec: TrafficSpec, clock=None,
+                         executor: str = "device",
+                         service_model=None,
+                         warmup: bool = True,
+                         requests: Optional[List[EcRequest]] = None,
+                         offsets: Optional[List[float]] = None
+                         ) -> ServingRun:
+    """Drive ``spec``'s stream through queue → batcher → SLO ledger.
+
+    ``executor="device"`` additionally wires the persistent
+    compilation cache (utils/compile_cache.py, when the env knob is
+    set), installs the compile monitor, and reports
+    ``stream_compiles`` — backend compiles AFTER warmup, the number
+    the zero-warm-recompile acceptance gate pins at 0.
+
+    ``requests`` (with ``offsets`` for open-loop arrival) substitutes
+    a pre-built request list for the generator's — the serve demo
+    degrades its repair payloads through the chaos injectors first
+    and then serves those exact objects.
+    """
+    from ..utils.retry import SystemClock
+
+    if clock is None:
+        clock = SystemClock()
+    if requests is not None:
+        reqs = requests
+        if spec.arrival == "open" and offsets is None:
+            raise ValueError("open-loop arrival needs offsets for a "
+                             "pre-built request list")
+    else:
+        gen = LoadGenerator(spec)
+        reqs, offsets = gen.generate()
+    slo = SloPolicy(deadlines=dict(spec.deadlines))
+    queue = AdmissionQueue(clock=clock, capacity=spec.queue_capacity,
+                           slo=slo)
+    batcher = ContinuousBatcher(clock=clock, ladder=spec.ladder,
+                                executor=executor,
+                                service_model=service_model)
+    sla = SlaRecorder(slo)
+    monitor = False
+    if executor == "device":
+        from ..telemetry import install_compile_monitor
+        from ..utils.compile_cache import maybe_initialize_compile_cache
+
+        maybe_initialize_compile_cache()
+        monitor = install_compile_monitor()
+    if warmup:
+        batcher.warmup(reqs)
+    compiles_before = _device_compiles() if monitor else None
+
+    results: List[EcResult] = []
+    start = clock.monotonic()
+
+    def _absorb(batch: List[EcResult]) -> None:
+        for res in batch:
+            sla.record(res)
+        results.extend(batch)
+
+    if spec.arrival == "open":
+        arrivals = [start + off for off in offsets]
+        i = 0
+        while i < len(reqs) or batcher.pending() or len(queue):
+            now = clock.monotonic()
+            while i < len(reqs) and arrivals[i] <= now:
+                queue.submit(reqs[i])
+                i += 1
+            fired = batcher.poll(queue)
+            _absorb(fired)
+            if fired:
+                continue
+            nxt = []
+            if i < len(reqs):
+                nxt.append(arrivals[i])
+            wake = batcher.next_wakeup()
+            if wake is not None:
+                nxt.append(wake)
+            if not nxt:
+                _absorb(batcher.flush())
+                break
+            now = clock.monotonic()
+            clock.sleep(max(min(nxt) - now, _TICK))
+    else:
+        i = 0
+        inflight = 0
+        while i < len(reqs) or batcher.pending() or len(queue):
+            while inflight < spec.concurrency and i < len(reqs):
+                if not queue.submit(reqs[i]):
+                    break
+                i += 1
+                inflight += 1
+            fired = batcher.poll(queue)
+            _absorb(fired)
+            inflight -= len(fired)
+            if fired:
+                continue
+            wake = batcher.next_wakeup()
+            if wake is None:
+                _absorb(batcher.flush())
+                break
+            clock.sleep(max(wake - clock.monotonic(), _TICK))
+    elapsed = clock.monotonic() - start
+    report = sla.report(elapsed, padding=batcher.padding_stats())
+    report["admitted"] = queue.admitted
+    report["rejected"] = queue.rejected
+    report["arrival"] = spec.arrival
+    report["seed"] = spec.seed
+    stream_compiles = None
+    if monitor:
+        stream_compiles = _device_compiles() - compiles_before
+        report["stream_compiles"] = stream_compiles
+    return ServingRun(results=results, report=report, queue=queue,
+                      batcher=batcher, stream_compiles=stream_compiles)

@@ -1,0 +1,155 @@
+"""Per-op-class SLO policy and evaluation.
+
+Two halves:
+
+- :class:`SloPolicy` — the declarative side: per-op-class deadline
+  defaults (seconds of slack a request gets when it arrives without
+  an explicit deadline) and latency objectives (the p99 targets the
+  report grades against).  The batcher's deadline-aware dispatch reads
+  ``deadline_for``; nothing else in the data plane consults the
+  policy, so swapping SLOs never retraces a program.
+- :class:`SlaRecorder` — the measuring side: every
+  :class:`~ceph_tpu.serve.queue.EcResult` lands here.  Latency
+  percentiles ride :class:`~ceph_tpu.telemetry.LatencyHistogram`
+  per op class (exact-at-the-edges p50/p99/p999, the same machinery
+  every bench row uses), deadline misses and bytes-under-SLO are
+  counted per class, and ``report()`` folds them into one
+  deterministic dict: sorted keys, derived rates rounded — two runs
+  of the same seeded scenario on a FakeClock serialize
+  byte-identically (pinned by tests/test_serve.py).
+
+GB/s-under-SLO is the serving headline: ONLY the bytes of requests
+that met their deadline count in the numerator, over wall-clock
+elapsed — throughput you could have promised, not throughput you
+happened to reach.  A padded dispatch that blows deadlines buys
+nothing here, which is exactly the tension the bucket ladder +
+slack-based firing is tuned against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..telemetry import LatencyHistogram
+from ..telemetry import metrics as tel
+from .queue import OPS, EcResult
+
+# generous host-scale defaults; serving scenarios set their own
+DEFAULT_DEADLINES = {"encode": 0.200, "decode": 0.200, "repair": 0.500}
+
+
+@dataclass(frozen=True)
+class SloPolicy:
+    """Per-op-class service-level objectives.
+
+    ``deadlines``: seconds of slack granted at admission when the
+    request has no explicit deadline.  ``p99_targets`` (optional):
+    latency objectives the report grades against (informational —
+    dispatch uses deadlines, not percentiles).
+    """
+
+    deadlines: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_DEADLINES))
+    p99_targets: Dict[str, float] = field(default_factory=dict)
+
+    def deadline_for(self, op: str) -> float:
+        if op not in OPS:
+            raise ValueError(f"op {op!r} not in {OPS}")
+        return self.deadlines.get(op, DEFAULT_DEADLINES[op])
+
+
+class SlaRecorder:
+    """Accumulates served results into the per-op-class SLO ledger."""
+
+    def __init__(self, policy: Optional[SloPolicy] = None) -> None:
+        self.policy = policy if policy is not None else SloPolicy()
+        self._hist: Dict[str, LatencyHistogram] = {}
+        self._wait: Dict[str, LatencyHistogram] = {}
+        self.count: Dict[str, int] = {}
+        self.misses: Dict[str, int] = {}
+        self.ok_bytes: Dict[str, int] = {}
+        self.total_bytes: Dict[str, int] = {}
+
+    def record(self, result: EcResult) -> None:
+        op = result.request.op
+        h = self._hist.get(op)
+        if h is None:
+            h = self._hist[op] = LatencyHistogram()
+            self._wait[op] = LatencyHistogram()
+            self.count[op] = self.misses[op] = 0
+            self.ok_bytes[op] = self.total_bytes[op] = 0
+        h.record(result.latency)
+        self._wait[op].record(result.queue_wait)
+        self.count[op] += 1
+        self.total_bytes[op] += result.request.work_bytes
+        if result.deadline_met:
+            self.ok_bytes[op] += result.request.work_bytes
+        else:
+            self.misses[op] += 1
+            tel.counter("serve_deadline_miss", op=op)
+        # mirror into the unified metrics plane (perf dump / prom)
+        tel.observe("serve_request_seconds", result.latency, op=op)
+
+    # -- readout ---------------------------------------------------------
+
+    def _pcts(self, hist: Optional[LatencyHistogram]) -> dict:
+        if hist is None or not hist.count:
+            return {"p50_ms": None, "p99_ms": None, "p999_ms": None}
+        p = hist.percentiles()
+        return {"p50_ms": round(p["p50"] * 1e3, 6),
+                "p99_ms": round(p["p99"] * 1e3, 6),
+                "p999_ms": round(p["p999"] * 1e3, 6)}
+
+    def report(self, elapsed: float,
+               padding: Optional[dict] = None) -> dict:
+        """The serving scorecard: per-op-class latency percentiles,
+        deadline-miss rates and GB/s-under-SLO, plus the overall roll-
+        up (and the batcher's padding accounting when provided).
+        Deterministic: dict insertion order is sorted, every derived
+        float is rounded."""
+        ops = sorted(self.count)
+        per_op = {}
+        for op in ops:
+            n = self.count[op]
+            per_op[op] = {
+                "requests": n,
+                "deadline_miss_rate": round(self.misses[op] / n, 6),
+                "bytes": self.total_bytes[op],
+                "gbps_under_slo": (
+                    round(self.ok_bytes[op] / elapsed / 1e9, 6)
+                    if elapsed > 0 else None),
+                **self._pcts(self._hist.get(op)),
+                "queue_wait": self._pcts(self._wait.get(op)),
+            }
+            target = self.policy.p99_targets.get(op)
+            if target is not None:
+                p99 = per_op[op]["p99_ms"]
+                per_op[op]["p99_target_ms"] = round(target * 1e3, 6)
+                per_op[op]["p99_met"] = (p99 is not None
+                                         and p99 <= target * 1e3)
+        total = sum(self.count.values())
+        total_bytes = sum(self.total_bytes.values())
+        ok_bytes = sum(self.ok_bytes.values())
+        misses = sum(self.misses.values())
+        # all-ops roll-up: bucket-exact merge of the per-class
+        # histograms (same log2 grid, so counts just add)
+        merged = LatencyHistogram()
+        for op in ops:
+            merged.merge(self._hist[op])
+        out = {
+            "elapsed_s": round(elapsed, 6),
+            "requests": total,
+            "deadline_miss_rate": (round(misses / total, 6)
+                                   if total else None),
+            "bytes": total_bytes,
+            "gbps": (round(total_bytes / elapsed / 1e9, 6)
+                     if elapsed > 0 else None),
+            "gbps_under_slo": (round(ok_bytes / elapsed / 1e9, 6)
+                               if elapsed > 0 else None),
+            **self._pcts(merged if merged.count else None),
+            "op_classes": per_op,
+        }
+        if padding is not None:
+            out["padding"] = dict(sorted(padding.items()))
+        return out

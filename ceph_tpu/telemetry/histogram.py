@@ -126,6 +126,27 @@ class LatencyHistogram:
         base["buckets"] = buckets
         return base
 
+    def merge(self, other: "LatencyHistogram") -> None:
+        """Fold ``other``'s recordings into this histogram — exact, not
+        approximate: both share the same log2/SUB grid, so bucket
+        counts add and the min/max extremes combine losslessly (the
+        serve SLA report's all-ops roll-up rides this)."""
+        with other._lock:
+            buckets = dict(other._buckets)
+            zeros = other._zeros
+            count, total = other.count, other.sum
+            omin, omax = other.min, other.max
+        with self._lock:
+            for idx, c in buckets.items():
+                self._buckets[idx] = self._buckets.get(idx, 0) + c
+            self._zeros += zeros
+            self.count += count
+            self.sum += total
+            if omin is not None and (self.min is None or omin < self.min):
+                self.min = omin
+            if omax is not None and (self.max is None or omax > self.max):
+                self.max = omax
+
     def reset(self) -> None:
         with self._lock:
             self._buckets.clear()

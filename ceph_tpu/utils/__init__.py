@@ -15,6 +15,9 @@
   scrub/, retry and the backend fallback policy (docs/ROBUSTNESS.md).
 - ``retry`` — bounded retry/backoff with an injectable clock (no real
   sleeps in tests).
+- ``compile_cache`` — the JAX persistent compilation cache behind the
+  ``CEPH_TPU_COMPILE_CACHE=<dir>`` env knob (cold-start compiles paid
+  once across processes; docs/SERVING.md).
 """
 
 from .perf import PerfCounters, global_perf, profile_trace  # noqa: F401
@@ -39,4 +42,10 @@ from .retry import (  # noqa: F401
     RetryStats,
     SystemClock,
     retry_call,
+)
+from .compile_cache import (  # noqa: F401
+    cache_entries,
+    compile_cache_dir,
+    install_cache_monitor,
+    maybe_initialize_compile_cache,
 )

@@ -1,0 +1,123 @@
+"""JAX persistent compilation cache behind one env knob.
+
+``CEPH_TPU_COMPILE_CACHE=<dir>`` points every process at a shared
+on-disk compilation cache (SNIPPETS.md [2] —
+``jax.experimental.compilation_cache``): cold-start compiles are paid
+ONCE across processes, which is the other half of the serving
+cold-start story (the bucket-ladder warmup kills per-process warm
+recompiles; this kills the per-process cold trace cost for programs
+any previous process already built).
+
+Wiring notes, pinned by tests/test_serve.py's two-process sentinel:
+
+- The thresholds ``jax_persistent_cache_min_compile_time_secs`` and
+  ``min_entry_size_bytes`` are zeroed: the default 1-second floor
+  would silently skip every small EC program and the knob would look
+  wired while caching nothing.
+- On this jax (0.4.37) a persistent-cache HIT still emits the
+  ``backend_compile`` duration event (the deserialization path runs
+  under the same span), so "second process compiled nothing" must be
+  asserted on the cache-miss counter, NOT the compile counter:
+  ``install_cache_monitor`` folds
+  ``/jax/compilation_cache/cache_hits|cache_misses`` into the
+  telemetry registry as ``jax_persistent_cache_hits`` /
+  ``jax_persistent_cache_misses`` — a warm replay is
+  ``misses == 0 and hits > 0``.
+- Initialization is lazy and idempotent; without the env knob (or
+  without jax) everything here is a no-op returning None/False, so
+  the default test environment never writes outside its sandbox.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ..telemetry import metrics as tel
+from .log import dout
+
+ENV_KNOB = "CEPH_TPU_COMPILE_CACHE"
+
+_lock = threading.Lock()
+_initialized_dir: Optional[str] = None
+_monitor_installed = False
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The configured cache directory (env knob), or None."""
+    return os.environ.get(ENV_KNOB) or None
+
+
+def maybe_initialize_compile_cache(
+        cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at ``cache_dir`` (or
+    the env knob).  Returns the active cache dir, or None when no dir
+    is configured / jax is unavailable.  Idempotent; re-pointing at a
+    DIFFERENT directory in one process raises (the cache dir is a
+    process-wide jax config)."""
+    global _initialized_dir
+    d = cache_dir or compile_cache_dir()
+    if not d:
+        return None
+    with _lock:
+        if _initialized_dir is not None:
+            if os.path.abspath(_initialized_dir) != os.path.abspath(d):
+                raise ValueError(
+                    f"compilation cache already initialized at "
+                    f"{_initialized_dir!r}; cannot re-point at {d!r}")
+            return _initialized_dir
+        try:
+            import jax
+        except ImportError:
+            return None
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # zero the write thresholds: EC programs compile in well under
+        # the default 1 s floor and would never be cached
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        _initialized_dir = d
+        tel.event("compile_cache_initialized", dir=d)
+        dout("serve", 5, f"persistent compilation cache at {d}")
+        return d
+
+
+def install_cache_monitor() -> bool:
+    """Fold jax's persistent-cache hit/miss monitoring events into the
+    telemetry registry (``jax_persistent_cache_hits`` /
+    ``jax_persistent_cache_misses``).  Idempotent; False when jax is
+    unavailable."""
+    global _monitor_installed
+    with _lock:
+        if _monitor_installed:
+            return True
+        try:
+            import jax.monitoring
+        except ImportError:
+            return False
+
+        def _listener(name: str, **kw) -> None:
+            if name == "/jax/compilation_cache/cache_hits":
+                tel.counter("jax_persistent_cache_hits")
+            elif name == "/jax/compilation_cache/cache_misses":
+                tel.counter("jax_persistent_cache_misses")
+
+        jax.monitoring.register_event_listener(_listener)
+        _monitor_installed = True
+        return True
+
+
+def cache_entries(cache_dir: Optional[str] = None) -> int:
+    """Number of cached executables on disk (``*-cache`` files) —
+    provenance for demo/bench lines, 0 when unconfigured."""
+    d = cache_dir or compile_cache_dir()
+    if not d or not os.path.isdir(d):
+        return 0
+    return sum(1 for f in os.listdir(d) if f.endswith("-cache"))
+
+
+__all__ = ["ENV_KNOB", "cache_entries", "compile_cache_dir",
+           "install_cache_monitor", "maybe_initialize_compile_cache"]

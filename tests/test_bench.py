@@ -261,13 +261,19 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    assert bench.METRIC_VERSION == 3
+    assert bench.METRIC_VERSION == 4
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
+    monkeypatch.setattr(bench, "_serving_rows",
+                        lambda host_only=False, requests=None: {})
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["metric_version"] == bench.METRIC_VERSION
     # metric_version 3: every emitted line carries the telemetry blob
     assert isinstance(err["telemetry"], dict)
+    # metric_version 4: every emitted line carries the serving rows
+    # (GB/s-under-SLO + latency percentiles; docs/SERVING.md)
+    assert "serving_rows" in err
+    assert dict(bench.SERVING_ROWS)  # at least one declared row
     # and bench rows are {gbps, lat_*} dicts (per-stripe-batch
     # latency percentiles alongside GB/s)
     row = bench._row_result({"gbps": 1.23456789, "lat_p50_ms": 0.5,
@@ -293,6 +299,8 @@ def test_bench_metadata_records_audit_coverage(monkeypatch):
     import bench
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
+    monkeypatch.setattr(bench, "_serving_rows",
+                        lambda host_only=False, requests=None: {})
     meta = bench._audit_meta()
     assert meta["audited_entrypoints"] >= 12
     assert meta["audit_rules"] == sorted([
@@ -311,6 +319,10 @@ def test_bench_last_good_roundtrip(tmp_path, monkeypatch):
     import bench
     monkeypatch.setattr(bench, "LAST_GOOD",
                         str(tmp_path / "BENCH_LAST_GOOD.json"))
+    monkeypatch.setattr(bench, "_degraded_rows",
+                        lambda iterations, host_only=False: {})
+    monkeypatch.setattr(bench, "_serving_rows",
+                        lambda host_only=False, requests=None: {})
     assert bench._read_last_good() is None
     line = {"metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
             "value": 116.7, "unit": "GB/s", "layout": "packed"}
@@ -321,3 +333,26 @@ def test_bench_last_good_roundtrip(tmp_path, monkeypatch):
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["value"] is None
     assert err["last_good"]["value"] == 116.7
+
+
+def test_serving_workload_host():
+    """--workload serving (metric_version 4): the seeded mixed stream
+    through the continuous batcher reports GB/s-under-SLO, request
+    latency percentiles, deadline-miss rate and padding overhead —
+    and byte-verifies every served request inside the workload."""
+    res = run_bench(["--workload", "serving", "--requests", "24",
+                     "--size", "4096", "--device", "host",
+                     "--seed", "7"])
+    assert res["workload"] == "serving"
+    assert res["requests"] == 24
+    assert res["gbps"] > 0
+    for f in ("gbps_under_slo", "deadline_miss_rate",
+              "padding_overhead", "lat_p50_ms", "lat_p99_ms",
+              "lat_p999_ms", "rejected", "dispatches"):
+        assert f in res, f
+    assert res["lat_samples"] == 24
+    assert 0.0 <= res["deadline_miss_rate"] <= 1.0
+    assert 0.0 <= res["padding_overhead"] < 1.0
+    # host executor never dispatches jax, so no compile accounting
+    assert res["stream_compiles"] is None
+    assert set(res["op_classes"]) <= {"encode", "decode", "repair"}

@@ -327,7 +327,17 @@ def _repair_scenario(seed=7, objects=5):
 def test_repair_scenario_deterministic_and_counters_correct():
     rep1, spans1, dump1 = _repair_scenario()
     rep2, spans2, dump2 = _repair_scenario()
-    # byte-identical observability across identical seeded runs
+    # byte-identical observability across identical seeded runs.
+    # The jax_backend_compile* series are excluded: once any earlier
+    # suite has installed the process-wide compile monitor (bench and
+    # the serving scenario driver both do), backend-compile counts
+    # are process-HISTORY-dependent by construction — run 1 warms
+    # process-global jit caches that run 2 then reuses — while every
+    # counter this scenario owns stays byte-identical.
+    for d in (dump1, dump2):
+        for k in [k for k in d["ceph_tpu_telemetry"]
+                  if k.startswith("jax_backend_compile")]:
+            d["ceph_tpu_telemetry"].pop(k)
     assert spans1 == spans2
     assert json.dumps(dump1, sort_keys=True) == \
         json.dumps(dump2, sort_keys=True)

@@ -425,3 +425,32 @@ def test_perf_dump_cli_deterministic_and_valid():
     assert r.returncode == 0, r.stderr
     assert "ceph_tpu_telemetry_scrub_dispatch_seconds" in r.stdout
     assert "_total" in r.stdout and "quantile=" in r.stdout
+
+
+def test_serve_demo_recoverable_and_unrecoverable():
+    """tools/serve_demo.py: the seeded serving scenario CLI — rc 0
+    with a byte-verified stream, chaos-degraded repair slice and a
+    schema-valid telemetry dump; rc 2 with the structured report when
+    the erasure budget exceeds every code's decode capability (the
+    same gates tools/test_full.sh enforces)."""
+    import os
+    script = os.path.join(REPO_ROOT, "tools", "serve_demo.py")
+    r = subprocess.run([sys.executable, script, "--requests", "32",
+                        "--validate", "--json"],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["requests"] == 32
+    assert out["corrupted"] == []
+    assert out["verified"] == 32
+    assert out["degraded_repairs"] >= 1          # chaos slice exercised
+    assert out["telemetry_schema_errors"] == []
+    assert out["padding"]["dispatches"] == len(out["dispatches"])
+
+    r = subprocess.run([sys.executable, script, "--erasures", "4",
+                        "--json"],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 2, r.stderr
+    out = json.loads(r.stdout)
+    assert out["unrecoverable"] is True
+    assert "erasure" in out["error"] or "decodable" in out["error"]

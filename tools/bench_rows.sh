@@ -89,6 +89,11 @@ run_row "row 4b: jerasure RS decode, packed layout" \
     -s $((1<<20)) --workload decode -e 2 --batch 64 --loop 1024 \
     --layout packed --json
 
+run_row "row 7: serving — mixed rs/shec/clay request stream, closed loop (GB/s-under-SLO + latency percentiles; metric_version 4)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    --workload serving -s $((1<<16)) --requests 256 \
+    --concurrency 64 --seed 42 --json
+
 run_row "row 5: 1M-PG bulk CRUSH sweep on device" \
     python tools/bulk_crush_row.py
 

@@ -44,4 +44,14 @@ python tools/perf_dump.py --scenario both --fake-clock --validate \
     >/dev/null || { echo "perf_dump: telemetry schema gate failed"; exit 1; }
 python tools/perf_dump.py --check-overhead 3 \
     || { echo "perf_dump: instrumentation overhead above 3%"; exit 1; }
+# Serving gate (ISSUE 7 / docs/SERVING.md): the seeded mixed
+# rs/shec/clay stream with the chaos-degraded repair slice must serve
+# byte-identical under a schema-valid telemetry dump (rc 0), and an
+# erasure budget past every code's decode capability must exit with
+# the structured unrecoverable report (rc 2) — the 500-request
+# zero-recompile stream runs inside tier-1 as tests/test_serve.py.
+python tools/serve_demo.py --requests 48 --validate >/dev/null \
+    || { echo "serve_demo: serving gate failed"; exit 1; }
+python tools/serve_demo.py --erasures 4 >/dev/null 2>&1
+[ $? -eq 2 ] || { echo "serve_demo: expected unrecoverable rc 2"; exit 1; }
 CEPH_TPU_FULL=1 exec python -m pytest tests/ -q "$@"
