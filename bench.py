@@ -67,7 +67,14 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # request-latency p50/p99/p999, deadline_miss_rate, padding_overhead
 # and the post-warmup compile count (0 = zero warm recompiles held).
 # Consumers that only read `value`/`decode_rows` are unaffected.
-METRIC_VERSION = 4
+# v5 (ISSUE 8, multichip): every line — headline AND error — carries a
+# `topology` field {platform, device_count, mesh_shape} so host-only
+# tunnel-down rounds are self-describing next to real device runs,
+# and a `multichip_rows` section measures the mesh-sharded engine
+# tier (--workload multichip: stripe batch sharded over every visible
+# device through serve_dispatch_call, byte-verified against the
+# single-device engine, per-device partition reported).
+METRIC_VERSION = 5
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -159,6 +166,38 @@ SERVING_ROWS = [
       "--size", str(1 << 16), "--requests", "256",
       "--concurrency", "64", "--seed", "42"]),
 ]
+
+
+# Multichip rows (ISSUE 8): the mesh data plane — encode fanned out
+# across every visible device through the engine's sharded tier, ONE
+# dispatch per batch, byte-verified in-workload against the
+# single-device engine.  On a single-device (or tunnel-down) round
+# the plane degrades to single-device and the row says so
+# (n_devices/mesh_shape), so the scaling table is never fiction.
+MULTICHIP_ROWS = [
+    ("rs_k8_m3_multichip",
+     ["--plugin", "jerasure", "--parameter", "technique=reed_sol_van",
+      "--parameter", "k=8", "--parameter", "m=3",
+      "--size", str(1 << 20), "--workload", "multichip",
+      "--device", "jax", "--batch", "64", "--iterations", "8"]),
+]
+
+
+def _multichip_rows() -> dict:
+    rows = {}
+    for name, argv in MULTICHIP_ROWS:
+        try:
+            res = _run(argv)
+            row = _row_result(res)
+            for f in ("n_devices", "mesh_shape", "stripes_per_device",
+                      "platform", "verified"):
+                row[f] = res.get(f)
+            rows[name] = row
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"multichip/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
 
 
 def _serving_rows(host_only: bool = False, requests: int | None = None
@@ -316,7 +355,7 @@ def _audit_meta() -> dict:
 
 
 def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
-                host_gbps: float) -> dict:
+                host_gbps: float, probe: dict | None = None) -> dict:
     """The one-line JSON shape for runs that could not measure the
     device (both failure paths emit identical fields).  Embeds the
     last successful device measurement, with provenance, so the round
@@ -330,6 +369,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "baseline": cpp_src,
         "baseline_gbps": round(cpp_gbps, 3),
         "error": msg,
+        "topology": _topology(probe),
         "host_gbps": round(host_gbps, 3),
         "degraded_rows": _degraded_rows(iterations=1, host_only=True),
         "serving_rows": _serving_rows(host_only=True, requests=96),
@@ -364,11 +404,14 @@ def _cpp_baseline() -> tuple[float, str]:
     return RECORDED_CPP_RS_GBPS, RECORDED_CPP_RS_SRC
 
 
-def _device_reachable(timeout: int | None = None) -> bool:
+def _probe_device(timeout: int | None = None) -> dict | None:
     """Probe jax device init in a SUBPROCESS with a timeout: a wedged
     axon tunnel hangs inside the PJRT client C call (uninterruptible
     in-process — this exact failure ate the round-1 bench run), so the
-    probe must be killable from outside."""
+    probe must be killable from outside.  Returns the device topology
+    {platform, device_count} when the probe succeeds, None when it
+    does not — so even the error line can say what (if anything) was
+    reachable (metric_version 5)."""
     if timeout is None:
         # 100 s default (first axon dial needs ~30-60 s when healthy);
         # overridable so the watchdog / a hurried judge can tighten it
@@ -376,11 +419,29 @@ def _device_reachable(timeout: int | None = None) -> bool:
     try:
         r = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(len(jax.devices()))"],
+             "import jax; d = jax.devices(); "
+             "print(jax.default_backend(), len(d))"],
             capture_output=True, text=True, timeout=timeout)
-        return r.returncode == 0 and r.stdout.strip().isdigit()
+        parts = r.stdout.split()
+        if r.returncode != 0 or len(parts) != 2 or not parts[1].isdigit():
+            return None
+        return {"platform": parts[0], "device_count": int(parts[1])}
     except subprocess.TimeoutExpired:
-        return False
+        return None
+
+
+def _topology(probe: dict | None) -> dict:
+    """The per-line topology blob: probe result (or host-only nulls)
+    plus the active data-plane mesh shape, if any."""
+    topo = {"platform": None, "device_count": 0, "mesh_shape": None}
+    if probe:
+        topo.update(probe)
+    try:
+        from ceph_tpu.parallel.plane import plane_topology
+        topo["mesh_shape"] = plane_topology()
+    except Exception:  # noqa: BLE001 — metadata never kills bench
+        pass
+    return topo
 
 
 def main() -> int:
@@ -404,8 +465,8 @@ def main() -> int:
     # fail fast to the error line (VERDICT r04 weak#6 — the old order
     # spent ~3 min on host+cpp baselines before the probe, so an
     # impatient outer timeout killed the run before any line printed).
-    reachable = _device_reachable()
-    if not reachable:
+    probe = _probe_device()
+    if probe is None:
         # emit an honest line FAST rather than hanging the round's
         # bench run (VERDICT r04 weak#6: a hurried judge killed the
         # old path at 180 s): minimal host measurement, recorded cpp
@@ -415,7 +476,7 @@ def main() -> int:
         print(json.dumps(_error_line(
             "jax device init unreachable (tunnel down); "
             "host numpy GB/s in host_gbps", RECORDED_CPP_RS_GBPS,
-            RECORDED_CPP_RS_SRC, host["gbps"])))
+            RECORDED_CPP_RS_SRC, host["gbps"], probe)))
         return 0
     # CPU baseline: numpy reference region ops, small batch.
     host = _run(NORTH_STAR + ["--device", "host", "--batch", "4",
@@ -462,7 +523,8 @@ def main() -> int:
         # surface the cause so the two are distinguishable
         print(json.dumps(_error_line(
             "device runs failed after reachability probe: "
-            + "; ".join(errors), cpp_gbps, cpp_src, host["gbps"])))
+            + "; ".join(errors), cpp_gbps, cpp_src, host["gbps"],
+            probe)))
         return 0
     # decode rows (BASELINE rows 3-4 + RS shape) — recovery-path GB/s
     # in the official artifact, not only in bench_rows.sh
@@ -505,10 +567,12 @@ def main() -> int:
             default=None),
         "slice_gbps": slice_gbps,
         "percall_gbps": round(percall["gbps"], 3) if percall else None,
+        "topology": _topology(probe),
         "decode_gbps": (decode_rows.get("rs_k8_m3_e2") or {}).get("gbps"),
         "decode_rows": decode_rows,
         "degraded_rows": _degraded_rows(iterations=3),
         "serving_rows": _serving_rows(),
+        "multichip_rows": _multichip_rows(),
         "lat_p50_ms": best.get("lat_p50_ms"),
         "lat_p99_ms": best.get("lat_p99_ms"),
         "lat_p999_ms": best.get("lat_p999_ms"),

@@ -169,6 +169,13 @@ GF_MXU_PRIMS = GF_XLA_PRIMS | frozenset({
     "convert_element_type",
 })
 
+# The mesh-sharded engine tier (ISSUE 8, parallel/plane.py): the same
+# GF program per shard under ONE shard_map, plus the zero-stripe pad
+# for non-dividing batches.  Anything else appearing in a sharded
+# program (a collective, a gather) is drift worth reviewing — the
+# stripe-sharded tier must stay communication-free.
+GF_SHARD_PRIMS = GF_XLA_PRIMS | frozenset({"shard_map", "pad"})
+
 # CRUSH bulk rule evaluation: straw2 fixed-point draws, rjenkins hash
 # mixing, candidate-grid scans/fixpoints — integer end to end (gather
 # IS expected here: bucket item lookup is genuinely dynamic in x)
@@ -308,6 +315,103 @@ def _build_fused_repair() -> Built:
     fn = fused_repair_call(ec, available, erased)
     return Built(fn, (np.zeros((B, len(available), C), np.uint8),),
                  fused_repair_call)
+
+
+# ----------------------------------------------------------------------
+# mesh-sharded variants (ISSUE 8): the SAME programs with the stripe
+# batch sharded over an explicit plane spanning every visible device.
+# On a single-device run (the bare `tpu_lint --trace` process) the
+# plane degrades to the single-device program — the allowlists are
+# supersets, so the audit stays green either way; the simulated-mesh
+# gate in tools/test_full.sh re-audits these entries under
+# XLA_FLAGS=--xla_force_host_platform_device_count=8, where the
+# shard_map shape is real.
+
+_SHARD_B = 8  # divides every power-of-two mesh (1/2/4/8 devices)
+
+
+def _mesh_plane_all():
+    """An explicit DataPlane over every visible device (tp=1)."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+    from ..parallel.plane import DataPlane
+
+    return DataPlane(make_mesh(len(jax.devices()), tp=1))
+
+
+def _build_fused_repair_sharded() -> Built:
+    import numpy as np
+
+    from ..codes.engine import fused_repair_call
+
+    ec = representative_instance("jerasure")
+    available, erased = _erasure_pattern(ec)
+    fn = fused_repair_call(ec, available, erased, mesh=_mesh_plane_all())
+    return Built(fn, (np.zeros((_SHARD_B, len(available), C), np.uint8),),
+                 fused_repair_call)
+
+
+def _build_serve_dispatch_sharded() -> Built:
+    import numpy as np
+
+    from ..codes.engine import serve_dispatch_call
+
+    ec = representative_instance("jerasure")
+    k = ec.get_data_chunk_count()
+    fn = serve_dispatch_call(ec, "encode", mesh=_mesh_plane_all())
+    return Built(fn, (np.zeros((_SHARD_B, k, C), np.uint8),),
+                 serve_dispatch_call)
+
+
+def _build_apply_matrix_best_sharded() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_matrix_best
+
+    ms = _rs_static()
+    plane = _mesh_plane_all()
+    return Built(lambda x: apply_matrix_best(x, ms, 8, mesh=plane),
+                 (np.zeros((_SHARD_B, 4, C), np.uint8),),
+                 apply_matrix_best)
+
+
+def _build_crush_bulk_sharded() -> Built:
+    """The fused rule program jitted with the x batch sharded over the
+    plane (NamedSharding in/out — the crush/bulk.py mesh path).  Same
+    primitives as the single-device program: GSPMD sharding adds no
+    eqns, which is exactly the property worth pinning."""
+    import numpy as np
+
+    hit = _CRUSH_CACHE.get("bulk_sharded")
+    if hit is None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..crush import (CrushBuilder, step_chooseleaf_indep,
+                             step_emit, step_take)
+        from ..crush.bulk import CompiledCrushMap, compile_rule
+
+        plane = _mesh_plane_all()
+        b = CrushBuilder()
+        root = b.build_two_level(4, 2)
+        b.add_rule(0, [step_take(root), step_chooseleaf_indep(0, 1),
+                       step_emit()])
+        cm = CompiledCrushMap(b.map)
+        fn = compile_rule(cm, 0, 3)
+        shard = NamedSharding(plane.mesh, P(plane.axis))
+        repl = NamedSharding(plane.mesh, P())
+        jf = jax.jit(jax.vmap(fn, in_axes=(0, None)),
+                     in_shardings=(shard, repl),
+                     out_shardings=(shard, shard, shard))
+        wv = jnp.asarray(np.asarray(b.map.device_weights(),
+                                    dtype=np.int64))
+        xs = jnp.asarray(np.arange(_SHARD_B, dtype=np.int64))
+        hit = (jf, xs, wv, compile_rule)
+        _CRUSH_CACHE["bulk_sharded"] = hit
+    jf, xs, wv, anchor = hit
+    return Built(jf, (xs, wv), anchor)
 
 
 _CRUSH_CACHE: dict = {}
@@ -465,6 +569,21 @@ def registry() -> Tuple[EntryPoint, ...]:
         EntryPoint("engine.fused_repair_call", "engine", "jit",
                    _build_fused_repair, allow=GF_XLA_PRIMS,
                    trace_budget=16),
+        # the mesh-sharded tier (ISSUE 8): the same programs sharded
+        # over an explicit all-device plane; the simulated-mesh gate
+        # re-audits them at device_count=8
+        EntryPoint("engine.fused_repair_sharded", "engine", "jit",
+                   _build_fused_repair_sharded, allow=GF_SHARD_PRIMS,
+                   trace_budget=16),
+        EntryPoint("serve.dispatch_sharded", "serve", "jit",
+                   _build_serve_dispatch_sharded, allow=GF_SHARD_PRIMS,
+                   trace_budget=16),
+        EntryPoint("ops.apply_matrix_best_sharded", "ops", "jit",
+                   _build_apply_matrix_best_sharded,
+                   allow=GF_SHARD_PRIMS, trace_budget=16),
+        EntryPoint("crush.bulk_rule_sharded", "crush", "jit",
+                   _build_crush_bulk_sharded, allow=CRUSH_BULK_PRIMS,
+                   trace_budget=24),
         EntryPoint("crush.bulk_rule", "crush", "jit",
                    _build_crush_bulk, allow=CRUSH_BULK_PRIMS,
                    trace_budget=24),

@@ -132,7 +132,7 @@ class ErasureCodeBench:
         ap.add_argument("-w", "--workload", default="encode",
                         choices=["encode", "decode", "degraded",
                                  "repair-batched", "recovery-churn",
-                                 "serving"])
+                                 "serving", "multichip"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -559,6 +559,30 @@ class ErasureCodeBench:
 
     # -- output -------------------------------------------------------------
 
+    def _topology(self) -> dict:
+        """Device topology for the row's JSON line (ISSUE 8): which
+        hardware actually ran this number, so a tunnel-down host-only
+        round can never be mistaken for a device run.  --device host
+        rows report a null platform WITHOUT touching jax device init
+        (a wedged tunnel hangs inside the PJRT dial; the error-path
+        rows must stay killable) — unless a backend is already live in
+        this process, in which case reading it is free."""
+        topo = {"platform": None, "device_count": 0, "mesh_shape": None}
+        import sys as _sys
+        jax_mod = _sys.modules.get("jax")
+        if self.args.device != "jax":
+            if jax_mod is None:
+                return topo
+            from jax._src import xla_bridge as _xb  # peek, no init
+            if not getattr(_xb, "_backends", None):
+                return topo
+        import jax
+        topo["platform"] = jax.default_backend()
+        topo["device_count"] = jax.device_count()
+        from ..parallel.plane import plane_topology
+        topo["mesh_shape"] = plane_topology()
+        return topo
+
     def _result(self, workload: str, elapsed: float, total_bytes: int,
                 lat: "_LatTimer | None" = None) -> dict:
         gbps = total_bytes / elapsed / 1e9 if elapsed > 0 else float("inf")
@@ -576,6 +600,7 @@ class ErasureCodeBench:
             "chain": getattr(self.args, "chain", "carry"),
             "loop": getattr(self.args, "loop", 0),
             "gbps": gbps,
+            **self._topology(),
         }
         if lat is not None and lat.hist.count:
             pcts = lat.hist.percentiles()
@@ -917,6 +942,63 @@ class ErasureCodeBench:
         res["op_classes"] = rep["op_classes"]
         return res
 
+    # -- multichip (the mesh data plane: encode fanned out across the
+    # device mesh through the engine's sharded tier — ISSUE 8) ----------
+
+    def multichip(self) -> dict:
+        """Mesh-sharded encode throughput: --batch stripes of --size
+        bytes dispatched through the engine's sharded serving program
+        (serve_dispatch_call under an active data plane spanning every
+        visible device — stripe batch sharded, coding matrix
+        replicated, ONE device dispatch per call).  The output is
+        byte-verified against the single-device engine before timing,
+        and the row carries the mesh shape + per-device stripe
+        partition so host-only rounds (device_count 1) are
+        self-describing.  On a single visible device the plane
+        degrades to the single-device tier — the row then IS the
+        single-chip number, labeled as such."""
+        a = self.args
+        if a.device != "jax":
+            raise SystemExit(
+                "ceph_erasure_code_benchmark: error: --workload "
+                "multichip measures the mesh data plane; it requires "
+                "--device jax")
+        import jax
+
+        from ..codes.engine import serve_dispatch_call
+        from ..parallel.plane import mesh_plane, plane_topology
+
+        ec = self._instance()
+        data = self._make_batch(ec)
+        # single-device reference OUTSIDE the plane (byte-identity pin)
+        ref = np.asarray(
+            serve_dispatch_call(ec, "encode", mesh=False)(
+                jax.device_put(data)))
+        lat = _LatTimer()
+        with mesh_plane() as plane:
+            fn = serve_dispatch_call(ec, "encode")
+            staged = jax.device_put(data)
+            out = fn(staged)  # compile/warmup
+            np.asarray(out.ravel()[:4])
+            if not np.array_equal(np.asarray(out), ref):
+                raise RuntimeError(
+                    "multichip: sharded encode diverged from the "
+                    "single-device engine")
+            begin = time.perf_counter()
+            for _ in range(a.iterations):
+                out = lat.run(lambda: fn(staged))
+            np.asarray(out.ravel()[:4])  # completion barrier
+            elapsed = time.perf_counter() - begin
+            shards = sorted(s.data.shape[0]
+                            for s in out.addressable_shards)
+            res = self._result("multichip", elapsed,
+                               data.nbytes * a.iterations, lat)
+            res["mesh_shape"] = plane_topology(plane)
+        res["n_devices"] = (plane.n_devices if plane is not None else 1)
+        res["stripes_per_device"] = shards
+        res["verified"] = True
+        return res
+
     def _run_workload(self) -> dict:
         if self.args.workload == "encode":
             return self.encode()
@@ -928,6 +1010,8 @@ class ErasureCodeBench:
             return self.recovery_churn()
         if self.args.workload == "serving":
             return self.serving()
+        if self.args.workload == "multichip":
+            return self.multichip()
         return self.decode()
 
 

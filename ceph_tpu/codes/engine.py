@@ -161,8 +161,58 @@ def pattern_key(ec, kind: str, available: tuple, erased: tuple,
 
 # -- fused decode → re-encode (the batched scrub repair device call) ----
 
+def _resolve_mesh(mesh):
+    from ..parallel.plane import resolve_plane
+    plane = resolve_plane(mesh)
+    if plane is not None and plane.n_devices < 2:
+        return None
+    return plane
+
+
+def _shard_program(raw, plane, n_out: int):
+    """Wrap a per-shard (B_local, ..., C) -> rank-3 outputs body in
+    shard_map over the plane's stripe axis: the batch sharded, every
+    trace-time constant (decode/encode matrices, GF tables) replicated
+    by construction, non-dividing batches zero-padded and the pad rows
+    sliced off the outputs.  The body traces under
+    ``plane.single_device()`` so its engine selection picks the
+    single-device tier (no nested meshes).  ONE jitted program = ONE
+    device dispatch per call."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.plane import single_device
+    from ..utils.shard import batch_spec, shard_map_compat
+
+    ndev = plane.n_devices
+    spec = batch_spec(plane.axis, 3)
+
+    def body(local):
+        with single_device():
+            return raw(local)
+
+    sharded = shard_map_compat(
+        body, plane.mesh, in_specs=spec,
+        out_specs=tuple([spec] * n_out) if n_out > 1 else spec)
+
+    @jax.jit
+    def fn(stack):
+        b = stack.shape[0]
+        pad = (-b) % ndev
+        x = (jnp.pad(stack, ((0, pad),) + ((0, 0),) * (stack.ndim - 1))
+             if pad else stack)
+        out = sharded(x)
+        if not pad:
+            return out
+        if n_out == 1:
+            return out[:b]
+        return tuple(o[:b] for o in out)
+
+    return fn
+
+
 def fused_repair_call(ec, available: Tuple[int, ...],
-                      erased: Tuple[int, ...]):
+                      erased: Tuple[int, ...], mesh=None):
     """One jitted fn: survivors (B, n_avail, C) uint8 →
     (rec (B, n_erased, C), parity (B, m, C)) in a SINGLE device
     dispatch — decode of every erased shard plus the full parity
@@ -174,7 +224,15 @@ def fused_repair_call(ec, available: Tuple[int, ...],
     for the re-encode are assembled from survivor and decoded columns
     by static index, so the whole body jit-fuses.  Cached per
     (plugin, profile, pattern) in the global PatternCache — repeat
-    repair plans hit the warm trace."""
+    repair plans hit the warm trace.
+
+    When a data plane is active (parallel/plane.py; ``mesh`` overrides
+    it — a DataPlane, or falsy to force single-device), the program is
+    the SHARDED variant: the same decode→re-encode body under
+    shard_map with the stripe batch sharded over the mesh and the
+    matrices replicated — still exactly one device dispatch per
+    pattern batch, byte-identical, cached in the same PatternCache
+    keyspace under a mesh-suffixed key."""
     import jax
     import jax.numpy as jnp
 
@@ -182,7 +240,9 @@ def fused_repair_call(ec, available: Tuple[int, ...],
 
     available = tuple(available)
     erased = tuple(erased)
-    key = pattern_key(ec, "fused-repair", available, erased)
+    plane = _resolve_mesh(mesh)
+    extra = ("mesh", plane.n_devices) if plane is not None else ()
+    key = pattern_key(ec, "fused-repair", available, erased, extra)
 
     def build():
         mapping = _chunk_mapping(ec)
@@ -201,8 +261,7 @@ def fused_repair_call(ec, available: Tuple[int, ...],
                     f"data shard {shard} neither available nor erased "
                     f"in pattern (avail={available}, erased={erased})")
 
-        @jax.jit
-        def fn(stack):
+        def raw(stack):
             # named_scope is pure trace metadata (no primitives — the
             # jaxpr audit stays byte-identical); it labels the decode
             # and re-encode regions in TensorBoard device traces so
@@ -217,15 +276,22 @@ def fused_repair_call(ec, available: Tuple[int, ...],
                 parity = ec.encode_chunks_jax(data)
             return rec, parity
 
+        fn = (jax.jit(raw) if plane is None
+              else _shard_program(raw, plane, n_out=2))
+
         def timed(stack):
             # host-side dispatch latency histogram.  Tracer inputs
             # mean WE are being traced into a larger program — record
             # nothing (a trace-time clock read is fiction) and leave
             # the jaxpr telemetry-free by construction.
+            eager = not isinstance(stack, jax.core.Tracer)
+            if eager and plane is not None:
+                tel.counter("engine_mesh_dispatches",
+                            tier="fused-repair",
+                            devices=str(plane.n_devices))
             with tel.record_dispatch(
                     "engine_fused_repair_dispatch",
-                    eager=not isinstance(stack, jax.core.Tracer),
-                    plugin=type(ec).__name__):
+                    eager=eager, plugin=type(ec).__name__):
                 return fn(stack)
 
         return timed
@@ -236,7 +302,7 @@ def fused_repair_call(ec, available: Tuple[int, ...],
 # -- serving dispatch seam (serve/batcher.py's one device call) ---------
 
 def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
-                        erased: Tuple[int, ...] = ()):
+                        erased: Tuple[int, ...] = (), mesh=None):
     """One cached, jitted program per (plugin, profile, op, erasure
     pattern): the seam the continuous batcher (serve/batcher.py) fires
     its shape buckets through.
@@ -255,34 +321,46 @@ def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
     - ``repair``: delegates to :func:`fused_repair_call` — the batcher
       reuses the scrub path's decode→re-encode program (and its cache
       entry) verbatim.
-    """
+
+    With an active data plane (or an explicit ``mesh``), the program
+    is the sharded variant — the same body under shard_map, stripe
+    batch sharded, one dispatch per bucket fire, byte-identical —
+    cached under a mesh-suffixed key in the same keyspace, so serving
+    transparently fans out across devices."""
     if op == "repair":
-        return fused_repair_call(ec, available, erased)
+        return fused_repair_call(ec, available, erased, mesh=mesh)
     if op not in ("encode", "decode"):
         raise ValueError(f"serve op {op!r} must be encode|decode|repair")
     import jax
 
     available = tuple(available)
     erased = tuple(erased)
-    key = pattern_key(ec, f"serve-{op}", available, erased)
+    plane = _resolve_mesh(mesh)
+    extra = ("mesh", plane.n_devices) if plane is not None else ()
+    key = pattern_key(ec, f"serve-{op}", available, erased, extra)
 
     def build():
         if op == "encode":
-            @jax.jit
-            def fn(stack):
+            def raw(stack):
                 return ec.encode_chunks_jax(stack)
         else:
-            @jax.jit
-            def fn(stack):
+            def raw(stack):
                 return ec.decode_chunks_jax(stack, available, erased)
+
+        fn = (jax.jit(raw) if plane is None
+              else _shard_program(raw, plane, n_out=1))
 
         def timed(stack):
             # same trace-eagerness discipline as fused_repair_call:
             # record nothing when WE are being traced into a larger
             # program, so jaxprs stay telemetry-free
+            eager = not isinstance(stack, jax.core.Tracer)
+            if eager and plane is not None:
+                tel.counter("engine_mesh_dispatches",
+                            tier=f"serve-{op}",
+                            devices=str(plane.n_devices))
             with tel.record_dispatch(
-                    "serve_dispatch",
-                    eager=not isinstance(stack, jax.core.Tracer),
+                    "serve_dispatch", eager=eager,
                     op=op, plugin=type(ec).__name__):
                 return fn(stack)
 

@@ -1105,13 +1105,28 @@ def compile_rule(cm: CompiledCrushMap, ruleno: int, result_max: int,
 
 def _get_jitted(cm: CompiledCrushMap, ruleno: int, result_max: int,
                 bulk_tries: int, leaf_cap: int = LEAF_TRIES_CAP,
-                leaf_fix_iters: int = 1):
-    key = (ruleno, result_max, bulk_tries, leaf_cap, leaf_fix_iters)
+                leaf_fix_iters: int = 1, plane=None):
+    key = (ruleno, result_max, bulk_tries, leaf_cap, leaf_fix_iters,
+           None if plane is None else (plane.mesh, plane.axis))
     jf = cm._jit_cache.get(key)
     if jf is None:
         fn = compile_rule(cm, ruleno, result_max, bulk_tries, leaf_cap,
                           leaf_fix_iters)
-        jf = jax.jit(jax.vmap(fn, in_axes=(0, None)))
+        vf = jax.vmap(fn, in_axes=(0, None))
+        if plane is None:
+            jf = jax.jit(vf)
+        else:
+            # mesh-sharded PG sweep (the NamedSharding path that used
+            # to live only in parallel/sharded_crush.py): the x batch
+            # shards over the plane's axis, the compiled map tables
+            # and weight vector replicate, and GSPMD partitions the
+            # sweep with zero cross-device collectives — placement
+            # evaluation is embarrassingly parallel over x
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            shard = NamedSharding(plane.mesh, P(plane.axis))
+            repl = NamedSharding(plane.mesh, P())
+            jf = jax.jit(vf, in_shardings=(shard, repl),
+                         out_shardings=(shard, shard, shard))
         cm._jit_cache[key] = jf
     return jf
 
@@ -1230,7 +1245,8 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
                  weight: Optional[Sequence[int]] = None,
                  bulk_tries: Optional[int] = None,
                  return_stats: bool = False,
-                 choose_args: Optional[Dict[int, "ChooseArg"]] = None):
+                 choose_args: Optional[Dict[int, "ChooseArg"]] = None,
+                 mesh=None):
     """Evaluate a rule for many inputs at once on device; bit-identical
     to the host mapper.
 
@@ -1241,9 +1257,20 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
     a budget is byte-identical at any larger budget, so the ladder never
     changes results — only where they are computed.
 
+    ``mesh``: shard the PG (x) axis over a device mesh — a DataPlane /
+    jax Mesh, or None to follow the active data plane
+    (parallel/plane.py; single-device when none is active).  Blocks
+    round up to the device count and the x batch pads by repetition
+    (lane results are x-pure, so pad lanes are discarded exactly like
+    the tail pad).  Same rung ladder, same host residue, bit-identical
+    results — the mesh only moves where lanes are computed.
+
     Returns (results (N, result_max) int32 with CRUSH_ITEM_NONE holes,
     counts (N,)); with return_stats also the host-fallback lane count.
     """
+    from ..parallel.plane import resolve_plane
+    plane = resolve_plane(mesh)
+    nd = plane.n_devices if plane is not None else 1
     if isinstance(cmap, CompiledCrushMap):
         cm = cmap
         if choose_args is not None and cm.choose_args is not choose_args:
@@ -1272,7 +1299,12 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
     # share one compiled program (the tail pads to the block shape)
     block = min(n, auto_block(cm.cmap, ruleno, result_max,
                               rungs[0][0])) or 1
-    jf = _get_jitted(cm, ruleno, result_max, *rungs[0])
+    if nd > 1:
+        block = -(-block // nd) * nd  # shard_map-divisible blocks
+        from ..telemetry import metrics as tel
+        tel.counter("engine_mesh_dispatches", tier="crush-bulk",
+                    devices=str(nd))
+    jf = _get_jitted(cm, ruleno, result_max, *rungs[0], plane=plane)
     for s in range(0, n, block):
         e = min(s + block, n)
         xs_b = xs[s:e]
@@ -1290,17 +1322,20 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
     for tries, lcap, fix in rungs[1:]:
         if not redo.size:
             break
-        if (redo.size < 512
-                and (ruleno, result_max, tries, lcap, fix)
-                not in cm._jit_cache):
+        rung_key = (ruleno, result_max, tries, lcap, fix,
+                    None if plane is None else (plane.mesh, plane.axis))
+        if redo.size < 512 and rung_key not in cm._jit_cache:
             # compiling a deeper rung (~2 s) costs more than walking a
             # few hundred lanes through the host mapper — small sweeps
             # (tests, tools on toy maps) stop here; results are
             # identical either way (the ladder invariant)
             continue
-        jf2 = _get_jitted(cm, ruleno, result_max, tries, lcap, fix)
+        jf2 = _get_jitted(cm, ruleno, result_max, tries, lcap, fix,
+                          plane=plane)
         rblock = min(block, auto_block(cm.cmap, ruleno, result_max,
                                        tries)) or 1
+        if nd > 1:
+            rblock = -(-rblock // nd) * nd
         host_lanes = []
         for s in range(0, len(redo), rblock):
             idx = redo[s:s + rblock]
@@ -1309,6 +1344,8 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
             # bounded set of compiled shapes
             padm = 1 << max(10, (m - 1).bit_length())
             padm = min(padm, rblock)
+            if nd > 1:
+                padm = min(-(-padm // nd) * nd, rblock)
             xs_r = xs[idx]
             if padm > m:
                 xs_r = np.concatenate([xs_r, xs_r[:1].repeat(padm - m)])

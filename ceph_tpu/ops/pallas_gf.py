@@ -384,9 +384,26 @@ def _bytes_to_packed(chunks: jax.Array):
         chunks.reshape(lead + (r, c // (4 * LANE), LANE, 4)), jnp.uint32)
 
 
-def apply_matrix_packed_best(words: jax.Array, matrix_t) -> jax.Array:
+def _run_matrix_packed(words: jax.Array, matrix_t, eng: str) -> jax.Array:
+    """Execute ONE single-device tier on a packed-layout array (the
+    dispatch body of apply_matrix_packed_best, shared with the mesh
+    tier's per-shard callable)."""
+    from . import xla_ops
+    if eng == "mxu":
+        out = xla_ops.apply_matrix_mxu(_packed_to_bytes(words), matrix_t)
+        return _bytes_to_packed(out)
+    if eng == "pallas":
+        return apply_matrix_pallas_packed(words, matrix_t)
+    out = xla_ops.apply_matrix_xla(_packed_to_bytes(words), matrix_t, 8)
+    return _bytes_to_packed(out)
+
+
+def apply_matrix_packed_best(words: jax.Array, matrix_t,
+                             mesh=None) -> jax.Array:
     """Packed-layout dispatch through the selection table
-    (select_matrix_engine / docs/PERF.md): MXU for large composite
+    (select_matrix_engine / docs/PERF.md): the mesh tier when a data
+    plane is active (stripe-batch axis sharded over the mesh, the
+    single-device tier running per shard), MXU for large composite
     matrices, the generalized Pallas packed kernel otherwise on TPU;
     on other backends, bitcast to bytes and take the XLA path (CPU has
     no tiled layouts, so the casts are cheap there).  Byte-identical
@@ -396,21 +413,15 @@ def apply_matrix_packed_best(words: jax.Array, matrix_t) -> jax.Array:
     record into the ``ops_apply_matrix_*`` telemetry histogram with
     the chosen engine tier as a label; traced calls record nothing,
     so jitted programs stay telemetry-free (docs/OBSERVABILITY.md)."""
-    from . import xla_ops
     from ..telemetry.metrics import record_dispatch
-    eng = select_matrix_engine(words.shape, matrix_t, 8, packed=True)
+    eng = select_matrix_engine(words.shape, matrix_t, 8, packed=True,
+                               mesh=mesh)
     with record_dispatch("ops_apply_matrix",
                          eager=not isinstance(words, jax.core.Tracer),
                          engine=eng, layout="packed"):
-        if eng == "mxu":
-            out = xla_ops.apply_matrix_mxu(_packed_to_bytes(words),
-                                           matrix_t)
-            return _bytes_to_packed(out)
-        if eng == "pallas":
-            return apply_matrix_pallas_packed(words, matrix_t)
-        out = xla_ops.apply_matrix_xla(_packed_to_bytes(words),
-                                       matrix_t, 8)
-        return _bytes_to_packed(out)
+        if eng == "mesh":
+            return _apply_matrix_mesh(words, matrix_t, 8, True, mesh)
+        return _run_matrix_packed(words, matrix_t, eng)
 
 
 def _bitmatrix_kernel(rows_masks, s: int, w: int, r: int, rt: int):
@@ -524,14 +535,29 @@ def _matrix_nnz(matrix_t) -> int:
     return sum(1 for row in matrix_t for v in row if v)
 
 
+def _resolve_mesh(mesh):
+    """Resolve the ``mesh`` argument of the dispatchers: None -> the
+    active data plane (parallel/plane.py; None when none is active or
+    the call is inside a sharded program body), a DataPlane/Mesh ->
+    itself, falsy -> mesh tier disabled."""
+    from ..parallel.plane import resolve_plane
+    return resolve_plane(mesh)
+
+
 def select_matrix_engine(shape, matrix_t, w: int = 8,
                          packed: bool = False,
-                         engine: str | None = None) -> str:
+                         engine: str | None = None,
+                         mesh=None) -> str:
     """THE engine-selection table for GF(2^w) matrix applies — one
     place that decides, for a (shape, matrix, layout) triple, which
     compute tier runs it (docs/PERF.md has the human-readable table;
     ops/fallback.py supplies the device tier).  Returns one of:
 
+    - "mesh":   a data plane is active (parallel/plane.py) and the
+                shape carries a shardable stripe-batch axis — the
+                apply runs under shard_map with the batch sharded
+                over the mesh and the matrix replicated, the
+                single-device tier below executing per shard.
     - "mxu":    w=8 composite matrix with >= MXU_MATRIX_MIN nonzeros
                 on a Pallas-capable backend — the bit-sliced GF(2)
                 matmul (clay's 64x704 single-erasure composite).
@@ -540,16 +566,24 @@ def select_matrix_engine(shape, matrix_t, w: int = 8,
     - "xla":    the SWAR XLA path (non-TPU backends, or shapes no
                 Pallas variant supports).
     - "numpy":  the fallback policy dropped to the host ground truth;
-                callers must not dispatch through jax at all.
+                callers must not dispatch through jax at all.  The
+                mesh tier NEVER overrides this — a plane cannot make
+                a dead backend live, so it degrades here exactly like
+                the single-device table (never silently to host).
 
-    ``engine`` overrides the probed fallback-policy tier (tests).
-    Pure function of its arguments — the routing tests assert on it
+    ``engine`` overrides the probed fallback-policy tier and ``mesh``
+    the active data plane (tests).  Pure function of its arguments
+    plus the two process policies — the routing tests assert on it
     directly."""
     if engine is None:
         from .fallback import global_policy
         engine = global_policy().engine(_device_kind())
     if engine == "numpy":
         return "numpy"
+    plane = _resolve_mesh(mesh)
+    if (plane is not None and plane.n_devices > 1
+            and len(shape) >= (4 if packed else 3) and shape[0] >= 2):
+        return "mesh"
     if engine != "pallas":
         return "xla"
     nnz = _matrix_nnz(matrix_t) if matrix_t else 0
@@ -566,10 +600,93 @@ def select_matrix_engine(shape, matrix_t, w: int = 8,
     return "xla"
 
 
-def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
+def _run_matrix_bytes(chunks: jax.Array, matrix_t, w: int,
+                      eng: str) -> jax.Array:
+    """Execute ONE single-device tier on a byte/word-layout array (the
+    dispatch body of apply_matrix_best, shared with the mesh tier's
+    per-shard callable)."""
+    from . import xla_ops
+    from .xla_ops import apply_matrix_xla
+    if eng == "mxu":
+        # module attribute (not a local import) so the routing test
+        # can observe which engine was selected
+        return xla_ops.apply_matrix_mxu(chunks, matrix_t)
+    if eng == "pallas":
+        if w == 8:
+            return apply_matrix_pallas(chunks, matrix_t)
+        return apply_matrix_pallas_words(chunks, matrix_t, w)
+    return apply_matrix_xla(chunks, matrix_t, w)
+
+
+@functools.lru_cache(maxsize=256)
+def _mesh_apply_fn(mesh, axis: str, ndev: int, matrix_t, w: int,
+                   packed: bool, inner: str, rank: int):
+    """Compile-once cache of the mesh-tier program for one (mesh,
+    matrix, layout, inner tier, rank): the single-device apply under
+    shard_map with the stripe-batch axis sharded and the matrix a
+    replicated trace-time constant.  Non-dividing batches are
+    zero-padded up to the device count and the pad rows masked off the
+    output (GF region math is row-local, so pad stripes never mix into
+    real rows — the same argument as the packed kernels' row
+    padding).  jit caches per input shape on the returned wrapper, so
+    repeat batches re-trace nothing."""
+    from ..utils.shard import batch_spec, shard_map_compat
+
+    spec = batch_spec(axis, rank)
+
+    def body(local):
+        if packed:
+            return _run_matrix_packed(local, matrix_t, inner)
+        return _run_matrix_bytes(local, matrix_t, w, inner)
+
+    sharded = shard_map_compat(body, mesh, in_specs=spec, out_specs=spec)
+
+    @jax.jit
+    def fn(x):
+        b = x.shape[0]
+        pad = (-b) % ndev
+        if pad:
+            x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+        out = sharded(x)
+        return out[:b] if pad else out
+
+    return fn
+
+
+def _apply_matrix_mesh(x: jax.Array, matrix_t, w: int, packed: bool,
+                       mesh) -> jax.Array:
+    """The mesh tier: ONE sharded device dispatch over the active data
+    plane, byte-identical to the single-device tier by construction
+    (per-shard math is that tier verbatim; stripes are independent)."""
+    plane = _resolve_mesh(mesh)
+    # the per-shard tier, selected on the local shard shape with the
+    # mesh disabled (batch size never changes the support gates)
+    inner = select_matrix_engine((1,) + tuple(x.shape[1:]), matrix_t,
+                                 w, packed=packed, mesh=0)
+    if plane is None:
+        # the plane was deactivated between selection and dispatch:
+        # degrade to the single-device tier (never to host)
+        if packed:
+            return _run_matrix_packed(x, matrix_t, inner)
+        return _run_matrix_bytes(x, matrix_t, w, inner)
+    if not isinstance(x, jax.core.Tracer):
+        from ..telemetry import metrics as tel
+        tel.counter("engine_mesh_dispatches",
+                    tier=f"apply-{'packed' if packed else 'bytes'}",
+                    devices=str(plane.n_devices))
+    fn = _mesh_apply_fn(plane.mesh, plane.axis, plane.n_devices,
+                        matrix_t, w, packed, inner, x.ndim)
+    return fn(x)
+
+
+def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8,
+                      mesh=None) -> jax.Array:
     """Dispatch over the engines via select_matrix_engine,
     byte-identical in every branch (cross-pinned in tests):
 
+    - active data plane (parallel/plane.py) + a stripe-batched shape:
+      the mesh tier — the per-shard tier below under shard_map, batch
+      axis sharded, matrix replicated, one device dispatch.
     - w=8, LARGE matrix (>= MXU_MATRIX_MIN entries) on TPU: the
       bit-sliced GF(2) matmul on the MXU (clay composites).
     - w=8, uint8 in: the byte Pallas kernel on TPU (row counts off the
@@ -578,25 +695,17 @@ def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8) -> jax.Array:
     - w=16/32, word-typed in (uint16/uint32 views — what the plugin
       mixins pass): the word Pallas kernel on TPU, XLA otherwise.
     """
-    from . import xla_ops
-    from .xla_ops import apply_matrix_xla
     from ..telemetry.metrics import record_dispatch
     word_typed = ((w == 8 and chunks.dtype == jnp.uint8)
                   or (w in (16, 32) and chunks.dtype == _WORD_DTYPE.get(w)))
-    eng = (select_matrix_engine(chunks.shape, matrix_t, w)
+    eng = (select_matrix_engine(chunks.shape, matrix_t, w, mesh=mesh)
            if word_typed else "xla")
     with record_dispatch("ops_apply_matrix",
                          eager=not isinstance(chunks, jax.core.Tracer),
                          engine=eng, layout="bytes"):
-        if eng == "mxu":
-            # module attribute (not a local import) so the routing test
-            # can observe which engine was selected
-            return xla_ops.apply_matrix_mxu(chunks, matrix_t)
-        if eng == "pallas":
-            if w == 8:
-                return apply_matrix_pallas(chunks, matrix_t)
-            return apply_matrix_pallas_words(chunks, matrix_t, w)
-        return apply_matrix_xla(chunks, matrix_t, w)
+        if eng == "mesh":
+            return _apply_matrix_mesh(chunks, matrix_t, w, False, mesh)
+        return _run_matrix_bytes(chunks, matrix_t, w, eng)
 
 
 def apply_bitmatrix_best(chunks: jax.Array, bitmatrix_rows, w: int,

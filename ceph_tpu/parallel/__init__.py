@@ -11,8 +11,24 @@ and lets XLA insert ICI/DCN collectives (SURVEY.md §2.3 parallelism map):
   (the reference's "shards across OSDs"); parity needs an XOR-reduction
   across chips -> all_gather/psum-style collective over ICI, replacing
   the messenger's MOSDECSubOpWrite fan-out.
+
+Since ISSUE 8 the mesh is also a first-class ENGINE tier: an active
+:mod:`~ceph_tpu.parallel.plane` DataPlane makes
+``select_matrix_engine`` return "mesh", the engine's fused-repair /
+serving programs build sharded variants, and CRUSH bulk shards the PG
+axis — see docs/PERF.md "Multi-chip data plane".
 """
 
 from .mesh import make_mesh  # noqa: F401
+from .plane import (  # noqa: F401
+    DataPlane,
+    activate,
+    data_plane,
+    deactivate,
+    mesh_plane,
+    plane_topology,
+    resolve_plane,
+    set_data_plane,
+)
 from .sharded_codes import sharded_encode, sharded_roundtrip_step  # noqa: F401
 from .sharded_crush import default_crush_mesh, sharded_bulk_do_rule  # noqa: F401

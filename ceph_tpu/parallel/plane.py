@@ -1,0 +1,211 @@
+"""The process data plane — which mesh (if any) the engine shards over.
+
+ROADMAP item 1 / ISSUE 8: "N×chip" is just another tier in the
+engine-selection table.  This module holds the process-wide answer to
+"is a mesh active, and what is it": when a :class:`DataPlane` is
+active, ``ops/pallas_gf.py::select_matrix_engine`` returns ``"mesh"``
+for stripe-batched shapes, ``apply_matrix_best`` /
+``apply_matrix_packed_best`` run their per-shard tier under
+``shard_map`` with the stripe-batch axis sharded, the engine's fused
+repair / serving dispatch programs (codes/engine.py) build sharded
+variants cached in the same PatternCache keyspace, and
+``crush/bulk.py`` shards the PG axis via NamedSharding.
+
+Activation is explicit — ``activate()`` / the ``mesh_plane()`` context
+manager / the ``CEPH_TPU_MESH`` env knob — never inferred from device
+count alone: the single-device programs stay byte-for-byte what the
+audit registry certifies, and the sharded variants are registered as
+their own audited entry points (analysis/entrypoints.py).
+
+Degrade policy (mirrors ops/fallback.py): a plane that cannot form
+(fewer than 2 devices, no backend) degrades to the single-device tier
+— never silently to host — with a log line and a telemetry counter.
+
+``CEPH_TPU_MESH``:
+- unset / ``0`` / ``off``  — no auto-activation (explicit only);
+- ``auto`` / ``on``        — activate over every visible device at
+  first use;
+- ``<N>``                  — activate over the first N devices.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from ..utils.log import dout
+
+DEFAULT_AXIS = "stripe"
+
+
+class DataPlane:
+    """An active mesh + the axis name the stripe batch shards over.
+
+    The mesh is 2-D ``(stripe, chunk)`` with tp=1 by construction for
+    the engine tier (pure data parallelism over independent stripes;
+    the chunk-axis tp path stays in parallel/sharded_codes.py) — but
+    any mesh whose first axis is the batch axis works.
+    """
+
+    def __init__(self, mesh, axis: str = DEFAULT_AXIS) -> None:
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no axis {axis!r} "
+                             f"(axes: {mesh.axis_names})")
+        self.mesh = mesh
+        self.axis = axis
+
+    @property
+    def n_devices(self) -> int:
+        """Devices on the sharded axis (= devices doing stripe work)."""
+        return int(self.mesh.shape[self.axis])
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"DataPlane(axis={self.axis!r}, "
+                f"shape={dict(self.mesh.shape)})")
+
+
+_lock = threading.Lock()
+_active: Optional[DataPlane] = None
+_env_resolved = False
+_tls = threading.local()
+
+
+def _suppressed() -> bool:
+    return getattr(_tls, "depth", 0) > 0
+
+
+@contextmanager
+def single_device():
+    """Trace-time suppression: inside a mesh-tier program body the
+    per-shard compute must select the SINGLE-device tier (a nested
+    shard_map would be wrong math and wrong topology).  The sharded
+    program builders in pallas_gf/engine trace their bodies under this
+    context; it is thread-local, so concurrent builds don't interact."""
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+
+
+def _build_plane(n_devices: Optional[int]) -> Optional[DataPlane]:
+    """A tp=1 (pure-dp) plane over the first n devices, or None when a
+    mesh cannot form — the degrade-to-single-device path, logged and
+    counted, never silent."""
+    try:
+        import jax
+        avail = len(jax.devices())
+    except Exception as e:  # noqa: BLE001 - no backend = no plane
+        _degrade(f"no usable backend ({type(e).__name__}: {e})")
+        return None
+    n = avail if n_devices is None else min(n_devices, avail)
+    if n < 2:
+        _degrade(f"{n} device(s) visible; mesh tier needs >= 2")
+        return None
+    from .mesh import make_mesh
+    return DataPlane(make_mesh(n, tp=1))
+
+
+def _degrade(reason: str) -> None:
+    dout("ec", 1, f"data plane degraded to single-device: {reason}")
+    from ..telemetry import metrics as tel
+    tel.counter("engine_mesh_degraded")
+    tel.event("engine_mesh_degraded", reason=reason)
+
+
+def data_plane() -> Optional[DataPlane]:
+    """The active plane, or None (single-device engine).  Resolves the
+    ``CEPH_TPU_MESH`` env default on first call; always None inside a
+    :func:`single_device` region (sharded program bodies)."""
+    global _active, _env_resolved
+    if _suppressed():
+        return None
+    with _lock:
+        if not _env_resolved:
+            _env_resolved = True
+            env = os.environ.get("CEPH_TPU_MESH", "").strip().lower()
+            if env in ("", "0", "off", "no", "none"):
+                pass
+            elif env in ("auto", "on"):
+                _active = _build_plane(None)
+            else:
+                try:
+                    _active = _build_plane(int(env))
+                except ValueError:
+                    _degrade(f"unparseable CEPH_TPU_MESH={env!r}")
+        return _active
+
+
+def activate(n_devices: Optional[int] = None) -> Optional[DataPlane]:
+    """Activate a plane over (the first n of) the visible devices.
+    Returns the plane, or None when one cannot form (degrade policy
+    above); the previous plane, if any, is replaced."""
+    global _active, _env_resolved
+    plane = _build_plane(n_devices)
+    with _lock:
+        _env_resolved = True
+        _active = plane
+    return plane
+
+
+def deactivate() -> Optional[DataPlane]:
+    """Drop back to the single-device engine; returns the old plane."""
+    global _active, _env_resolved
+    with _lock:
+        prev = _active
+        _active = None
+        _env_resolved = True
+        return prev
+
+
+def set_data_plane(plane: Optional[DataPlane]) -> Optional[DataPlane]:
+    """Swap the process plane (tests); returns the previous one."""
+    global _active, _env_resolved
+    with _lock:
+        prev = _active
+        _active = plane
+        _env_resolved = True
+        return prev
+
+
+def resolve_plane(mesh) -> Optional[DataPlane]:
+    """Resolve a dispatcher's ``mesh`` argument to a DataPlane:
+
+    - ``None``       -> the active plane (or None — single-device);
+    - a DataPlane    -> itself;
+    - a jax Mesh     -> wrapped, first axis as the batch axis;
+    - falsy (0/False)-> None (mesh tier explicitly disabled).
+    """
+    if mesh is None:
+        return data_plane()
+    if isinstance(mesh, DataPlane):
+        return mesh
+    if not mesh:
+        return None
+    return DataPlane(mesh, axis=mesh.axis_names[0])
+
+
+@contextmanager
+def mesh_plane(n_devices: Optional[int] = None):
+    """Activate a plane for the duration of a block (bench workloads,
+    tests); restores whatever was active before, including "nothing"."""
+    global _active, _env_resolved
+    with _lock:
+        prev, prev_resolved = _active, _env_resolved
+    plane = activate(n_devices)
+    try:
+        yield plane
+    finally:
+        with _lock:
+            _active, _env_resolved = prev, prev_resolved
+
+
+def plane_topology(plane: Optional[DataPlane] = None) -> Optional[list]:
+    """[dp, tp]-style mesh shape for bench metadata, or None."""
+    if plane is None:
+        plane = data_plane()
+    if plane is None:
+        return None
+    return [int(plane.mesh.shape[a]) for a in plane.mesh.axis_names]

@@ -22,18 +22,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# jax.shard_map landed in 0.6; on older releases it lives in
-# jax.experimental with check_rep instead of check_vma — same knob
-# (skip the replication static analysis), renamed upstream.
-if hasattr(jax, "shard_map"):
-    _shard_map = jax.shard_map
-    _SM_NOCHECK = {"check_vma": False}
-else:  # pragma: no cover - version-dependent branch
-    from jax.experimental.shard_map import shard_map as _shard_map
-    _SM_NOCHECK = {"check_rep": False}
-
 from ..matrices.jerasure import reed_sol_vandermonde_coding_matrix
 from ..ops.xla_ops import apply_matrix_xla, matrix_to_static
+from ..utils.shard import shard_map_compat
 
 
 def _partial_parity_fn(matrix: np.ndarray, tp: int):
@@ -69,13 +60,14 @@ def _sharded_encode_fn(mesh: Mesh, matrix_key: tuple):
             acc = acc ^ parts[t]
         return acc
 
-    # no replication check: the XOR of all_gather'ed partials IS
-    # replicated across "chunk", but the static analysis can't see
-    # through the axis_index-driven lax.switch that picked the slice.
-    return jax.jit(_shard_map(
-        step, mesh=mesh,
+    # no replication check (shard_map_compat's default): the XOR of
+    # all_gather'ed partials IS replicated across "chunk", but the
+    # static analysis can't see through the axis_index-driven
+    # lax.switch that picked the slice.
+    return jax.jit(shard_map_compat(
+        step, mesh,
         in_specs=P("stripe", "chunk", None),
-        out_specs=P("stripe", None, None), **_SM_NOCHECK))
+        out_specs=P("stripe", None, None)))
 
 
 def sharded_encode(mesh: Mesh, data, matrix: np.ndarray):
@@ -122,17 +114,26 @@ def sharded_single_erasure_repair(mesh: Mesh, plugin: str, profile,
                                   data):
     """Sharded RECOVERY math: encode a stripe batch host-side, erase
     chunk 0, compute the plugin's minimum read set (shec: < k chunks;
-    clay: d helpers with sub-chunk ranges), then run the plugin's
-    device decode with the batch sharded over EVERY mesh device (dp
-    over the flattened stripe x chunk axes; XLA partitions the batch,
-    no cross-chip traffic — recovery is per-stripe independent).
+    clay: d helpers with sub-chunk ranges), then decode through the
+    ENGINE's cached per-pattern program
+    (codes/engine.py::serve_dispatch_call, kind="serve-decode" — the
+    same PatternCache entry the serving batcher fires) built as its
+    mesh-sharded variant: the stripe batch dp-shards over EVERY mesh
+    device in ONE device dispatch, because recovery is per-stripe
+    independent.
 
-    This is the multi-chip face of the decode path (the recovery math,
-    SURVEY §5) — the same surface the single-chip decode rows measure.
+    This predates PR 3's unified engine and used to hand-roll a
+    throwaway ``jax.jit(decode)`` per call; since ISSUE 8 it IS the
+    engine path, so the multi-chip face and the single-chip decode
+    path (and their pattern caches) can never diverge — while still
+    reading only the minimum set, the property the driver's
+    ``dryrun_multichip`` pins.
 
     Returns (repaired (B, 1, C), n_read, n_chunks).
     """
+    from ..codes.engine import serve_dispatch_call
     from ..codes.registry import ErasureCodePluginRegistry
+    from .plane import DataPlane
 
     ec = ErasureCodePluginRegistry.instance().factory(plugin, profile)
     n = ec.get_chunk_count()
@@ -142,8 +143,9 @@ def sharded_single_erasure_repair(mesh: Mesh, plugin: str, profile,
     minimum = ec.minimum_to_decode({0}, set(range(1, n)))
     positions = tuple(sorted(minimum))
     surv = np.ascontiguousarray(allchunks[:, positions, :])
-    sharded = jax.device_put(
-        surv, NamedSharding(mesh, P(tuple(mesh.axis_names), None, None)))
-    out = jax.jit(
-        lambda s: ec.decode_chunks_jax(s, positions, erased))(sharded)
+    # dp over every device: flatten the mesh onto one stripe axis
+    flat = Mesh(mesh.devices.reshape(-1, 1), ("stripe", "chunk"))
+    fn = serve_dispatch_call(ec, "decode", positions, erased,
+                             mesh=DataPlane(flat))
+    out = fn(jax.device_put(surv))
     return np.asarray(out), len(positions), n

@@ -9,9 +9,13 @@ collectives for the sweep itself (each chip evaluates its shard; only
 the caller-visible gather of results rides ICI).  This replaces the
 reference's fan-out of CrushTester work over CPU cores/daemons.
 
-Results remain bit-identical to the host mapper: lanes that exhaust
-the device try budget fall back to the exact host reference, same as
-the single-chip path.
+Since ISSUE 8 the NamedSharding path LIVES in crush/bulk.py
+(``bulk_do_rule(mesh=...)`` / the active data plane,
+parallel/plane.py): the engine path and the sharded path are one
+program, with the full rung ladder, blocked dispatch, and the exact
+host-reference residue — results are ALWAYS bit-identical to
+mapper.py / the C semantics on any mesh.  This module keeps the
+mesh-first convenience surface.
 """
 
 from __future__ import annotations
@@ -21,8 +25,7 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 
 def sharded_bulk_do_rule(mesh: Mesh, cmap, ruleno: int, xs,
@@ -30,48 +33,17 @@ def sharded_bulk_do_rule(mesh: Mesh, cmap, ruleno: int, xs,
                          weight: Optional[Sequence[int]] = None,
                          bulk_tries: Optional[int] = None,
                          choose_args: Optional[Dict] = None,
-                         axis: str = "x"):
-    """bulk_do_rule with the x sweep sharded over ``mesh`` axis
-    ``axis``.  Returns (results (N, result_max) int32, counts (N,))."""
+                         axis: Optional[str] = None):
+    """bulk_do_rule with the x sweep sharded over ``mesh`` (its first
+    axis unless ``axis`` names another).  Returns (results
+    (N, result_max) int32, counts (N,))."""
     from ..crush import bulk
-    from ..crush.mapper import crush_do_rule
-    from ..crush.types import CRUSH_ITEM_NONE
+    from .plane import DataPlane
 
-    cm = (cmap if isinstance(cmap, bulk.CompiledCrushMap)
-          else bulk.CompiledCrushMap(cmap, choose_args))
-    if weight is None:
-        weight = cm.cmap.device_weights()
-    tries = (bulk_tries if bulk_tries
-             else bulk.auto_tries(cm.cmap, ruleno, result_max))
-    # leaf_fix_iters=16 selects the convergent while_loop fixpoint for
-    # chooseleaf-indep leaf rejections (r05): without it, every
-    # reweight-rejected leaf try would flag need_host and serialize the
-    # sharded sweep through the host mapper.  On clean maps the loop
-    # body never executes (the pre-loop pass already converged).
-    fn = bulk.compile_rule(cm, ruleno, result_max, tries,
-                           leaf_fix_iters=16)
-    n_dev = mesh.shape[axis]
-    xs = np.asarray(xs, dtype=np.int64)
-    n = len(xs)
-    pad = (-n) % n_dev
-    xs_p = np.concatenate([xs, xs[:1].repeat(pad)]) if pad else xs
-
-    shard = NamedSharding(mesh, P(axis))
-    repl = NamedSharding(mesh, P())
-    jf = jax.jit(jax.vmap(fn, in_axes=(0, None)),
-                 in_shardings=(shard, repl),
-                 out_shardings=(shard, shard, shard))
-    wv = jnp.asarray(np.asarray(weight, dtype=np.int64))
-    out, cnt, need_host = jf(jnp.asarray(xs_p), wv)
-    out = np.asarray(out)[:n].copy()
-    cnt = np.asarray(cnt)[:n].copy()
-    for i in np.nonzero(np.asarray(need_host)[:n])[0]:
-        r = crush_do_rule(cm.cmap, ruleno, int(xs[i]), result_max,
-                          weight=list(weight),
-                          choose_args=cm.choose_args)
-        out[i] = r + [CRUSH_ITEM_NONE] * (result_max - len(r))
-        cnt[i] = len(r)
-    return out, cnt
+    plane = DataPlane(mesh, axis=axis or mesh.axis_names[0])
+    return bulk.bulk_do_rule(cmap, ruleno, xs, result_max,
+                             weight=weight, bulk_tries=bulk_tries,
+                             choose_args=choose_args, mesh=plane)
 
 
 def default_crush_mesh(axis: str = "x") -> Mesh:

@@ -1,0 +1,43 @@
+"""Version-portable shard_map — THE one import shim.
+
+jax.shard_map landed in 0.6 with ``check_vma``; on earlier releases it
+lives in jax.experimental.shard_map with the same knob named
+``check_rep`` (skip the output-replication static analysis — renamed
+upstream, semantics unchanged).  tpu-lint's PR-1 sweep found the 0.6+
+spelling hard-imported in parallel/sharded_codes.py (4 seed test
+failures on the pinned jax); the version gate that fixed it then grew
+copies as the mesh tier spread.  This module is the single place that
+knows about the rename — everything that shards (parallel/, the
+engine-selection mesh tier in ops/pallas_gf.py, codes/engine.py's
+sharded program variants) calls :func:`shard_map_compat`.
+
+jax is imported lazily so the AST analysis tier keeps working in
+jax-free environments.
+"""
+
+from __future__ import annotations
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = False):
+    """``shard_map(fn, mesh, in_specs, out_specs)`` on any supported
+    jax, with the replication check off by default (the GF programs
+    XOR-reduce across shards in ways the static analysis cannot see
+    through; every sharded caller here pins byte-identity in tests
+    instead)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check)
+
+
+def batch_spec(axis: str, rank: int):
+    """PartitionSpec sharding axis 0 of a rank-``rank`` array over mesh
+    axis ``axis``, everything else replicated — the stripe-batch
+    sharding every mesh-tier program uses."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(axis, *([None] * (rank - 1)))

@@ -261,7 +261,7 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    assert bench.METRIC_VERSION == 4
+    assert bench.METRIC_VERSION == 5
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
@@ -274,6 +274,14 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     # (GB/s-under-SLO + latency percentiles; docs/SERVING.md)
     assert "serving_rows" in err
     assert dict(bench.SERVING_ROWS)  # at least one declared row
+    # metric_version 5: every line carries the device topology, so a
+    # tunnel-down host-only round is self-describing (ISSUE 8); the
+    # probe failed here, so the error line says "no device"
+    assert err["topology"]["platform"] is None
+    assert err["topology"]["device_count"] == 0
+    topo = bench._topology({"platform": "tpu", "device_count": 8})
+    assert (topo["platform"], topo["device_count"]) == ("tpu", 8)
+    assert dict(bench.MULTICHIP_ROWS)  # at least one declared row
     # and bench rows are {gbps, lat_*} dicts (per-stripe-batch
     # latency percentiles alongside GB/s)
     row = bench._row_result({"gbps": 1.23456789, "lat_p50_ms": 0.5,
@@ -333,6 +341,33 @@ def test_bench_last_good_roundtrip(tmp_path, monkeypatch):
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["value"] is None
     assert err["last_good"]["value"] == 116.7
+
+
+def test_multichip_workload_simulated_mesh():
+    """--workload multichip (metric_version 5): encode sharded over
+    the 8-device virtual CPU mesh through the engine's sharded serving
+    program — byte-verified in-workload against the single-device
+    engine, per-device stripe partition reported."""
+    res = run_bench(["--workload", "multichip", "--plugin", "jerasure",
+                     "--parameter", "technique=reed_sol_van",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "8192", "--batch", "16",
+                     "--iterations", "2"])
+    assert res["workload"] == "multichip"
+    assert res["verified"] is True
+    assert res["n_devices"] == 8
+    assert res["mesh_shape"] == [8, 1]
+    assert res["stripes_per_device"] == [2] * 8
+    assert res["platform"] == "cpu"
+    assert res["device_count"] == 8
+    assert res["gbps"] > 0
+    assert res["lat_samples"] == 2
+
+
+def test_multichip_workload_rejects_host_device():
+    with pytest.raises(SystemExit):
+        run_bench(["--workload", "multichip", "--device", "host",
+                   "--size", "4096"])
 
 
 def test_serving_workload_host():

@@ -94,6 +94,11 @@ run_row "row 7: serving — mixed rs/shec/clay request stream, closed loop (GB/s
     --workload serving -s $((1<<16)) --requests 256 \
     --concurrency 64 --seed 42 --json
 
+run_row "row 8: multichip — mesh-sharded encode over every visible device (ISSUE 8; byte-verified vs single-device, per-device partition in stripes_per_device)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<20)) --workload multichip --batch 64 --iterations 8 --json
+
 run_row "row 5: 1M-PG bulk CRUSH sweep on device" \
     python tools/bulk_crush_row.py
 

@@ -54,4 +54,22 @@ python tools/serve_demo.py --requests 48 --validate >/dev/null \
     || { echo "serve_demo: serving gate failed"; exit 1; }
 python tools/serve_demo.py --erasures 4 >/dev/null 2>&1
 [ $? -eq 2 ] || { echo "serve_demo: expected unrecoverable rc 2"; exit 1; }
+# Simulated-mesh gate (ISSUE 8 / docs/PERF.md "Multi-chip data
+# plane"): the sharded engine tier must hold on an 8-way virtual CPU
+# mesh — trace audit of the sharded entry points (shard_map program
+# shapes are only real at device_count > 1; the bare --trace above
+# runs them in single-device degrade mode) plus the sharded tier-1
+# slice, both in a subprocess with the device count forced.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python tools/tpu_lint.py --trace \
+    --entry engine.fused_repair_sharded \
+    --entry serve.dispatch_sharded \
+    --entry ops.apply_matrix_best_sharded \
+    --entry crush.bulk_rule_sharded \
+    || { echo "simulated-mesh gate: sharded entry audit failed"; exit 1; }
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_multichip.py tests/test_parallel.py -q \
+    || { echo "simulated-mesh gate: sharded tier-1 slice failed"; exit 1; }
 CEPH_TPU_FULL=1 exec python -m pytest tests/ -q "$@"
