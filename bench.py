@@ -74,7 +74,14 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # tier (--workload multichip: stripe batch sharded over every visible
 # device through serve_dispatch_call, byte-verified against the
 # single-device engine, per-device partition reported).
-METRIC_VERSION = 5
+# v6 (ISSUE 9, cluster plane): a `cluster_rows` section — the seeded
+# storm → balance → rateless-recover scenario over a synthetic
+# production-shape cluster (--workload cluster; ceph_tpu/cluster/) —
+# reporting remap convergence epochs, balancer iterations/final
+# deviation, p99 recovery ms vs the no-straggler control (the ratio
+# IS the rateless claim) and straggler_reassignments; host-only on
+# the tunnel-down error path at a downscaled size, same loop.
+METRIC_VERSION = 6
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -181,6 +188,50 @@ MULTICHIP_ROWS = [
       "--size", str(1 << 20), "--workload", "multichip",
       "--device", "jax", "--batch", "64", "--iterations", "8"]),
 ]
+
+
+# Cluster rows (ISSUE 9): the 10k-OSD cluster plane scaled to a
+# bench-bounded 1000 devices per round — churn storm through the
+# incremental path (remap convergence via the bulk evaluator, pinned
+# equivalent to rebuild + catch_up in-workload), the device-closed
+# balancer loop to max deviation <= 1, and rateless first-k recovery
+# under a 10x straggler with the no-straggler control ratio.
+CLUSTER_ROWS = [
+    ("cluster_1k_storm_balance_recover",
+     ["--plugin", "jerasure", "--parameter", "technique=reed_sol_van",
+      "--parameter", "k=4", "--parameter", "m=2",
+      "--size", str(1 << 16), "--workload", "cluster",
+      "--device", "jax", "--osds", "1000", "--cluster-pgs", "1024",
+      "--storm-events", "40", "--batch", "8", "--seed", "42"]),
+]
+
+CLUSTER_ROW_FIELDS = (
+    "osds", "total_pgs", "engine", "storm_events",
+    "remap_convergence_epochs", "mean_remap_fraction",
+    "balancer_iterations", "balancer_converged",
+    "balancer_max_dev_final", "p99_recovery_ms", "p99_baseline_ms",
+    "p99_ratio", "straggler_reassignments", "redundancy", "verified")
+
+
+def _cluster_rows(host_only: bool = False) -> dict:
+    rows = {}
+    for name, argv in CLUSTER_ROWS:
+        row_argv = list(argv)
+        if host_only:
+            # argparse last-wins: the identical loop over the host
+            # mapper at the workload's built-in downscale
+            row_argv += ["--device", "host"]
+        try:
+            res = _run(row_argv)
+            row = _row_result(res)
+            for f in CLUSTER_ROW_FIELDS:
+                row[f] = res.get(f)
+            rows[name] = row
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"cluster/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
 
 
 def _multichip_rows() -> dict:
@@ -373,6 +424,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "host_gbps": round(host_gbps, 3),
         "degraded_rows": _degraded_rows(iterations=1, host_only=True),
         "serving_rows": _serving_rows(host_only=True, requests=96),
+        "cluster_rows": _cluster_rows(host_only=True),
         "last_good": _read_last_good(),
         "telemetry": _telemetry_blob(),
         **_audit_meta(),
@@ -573,6 +625,7 @@ def main() -> int:
         "degraded_rows": _degraded_rows(iterations=3),
         "serving_rows": _serving_rows(),
         "multichip_rows": _multichip_rows(),
+        "cluster_rows": _cluster_rows(),
         "lat_p50_ms": best.get("lat_p50_ms"),
         "lat_p99_ms": best.get("lat_p99_ms"),
         "lat_p999_ms": best.get("lat_p999_ms"),

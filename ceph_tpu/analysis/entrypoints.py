@@ -45,11 +45,14 @@ from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
 
 # the five plugin families + the engine/ops/crush/scrub surfaces the
 # acceptance gate requires coverage for, plus the telemetry plane
-# (host-tier: its whole contract is "compiles nothing, ever") and the
+# (host-tier: its whole contract is "compiles nothing, ever"), the
 # serving front-end (jit tier: the bucketed dispatch program; host
-# tier: queue/batcher bookkeeping)
+# tier: queue/batcher bookkeeping) and the cluster plane (jit tier:
+# the balancer-round / storm-re-eval bulk programs over a
+# topology-generated map + the rateless over-planned dispatch)
 FAMILIES = ("jerasure", "isa", "shec", "lrc", "clay",
-            "engine", "ops", "crush", "scrub", "telemetry", "serve")
+            "engine", "ops", "crush", "scrub", "telemetry", "serve",
+            "cluster")
 
 # public device surfaces a plugin family can expose; the completeness
 # check requires every one present on a family's representative
@@ -445,6 +448,82 @@ def _build_crush_bulk() -> Built:
     return Built(jf, (xs, wv), anchor)
 
 
+# ----------------------------------------------------------------------
+# cluster plane (ISSUE 9): the jitted programs the 10k-OSD workloads
+# drive.  The balancer round and the storm re-eval are BOTH the fused
+# crush rule program, but over a topology-generated production-shape
+# map (4-level root→rack→host→osd tree): the replicated
+# chooseleaf-firstn rule is the balancer loop's per-round evaluation,
+# the canonical EC chooseleaf-indep rule (SET steps, scan/while
+# fixpoints) is what every storm epoch re-evaluates.  Small spec —
+# the audit is about program shape, not throughput.
+
+def _cluster_map():
+    hit = _CRUSH_CACHE.get("cluster_map")
+    if hit is None:
+        from ..cluster.topology import ClusterSpec, build_cluster
+        spec = ClusterSpec(seed=5, racks=3, hosts_per_rack=2,
+                           osds_per_host=2, replicated_pg_num=16,
+                           ec_pg_num=8, ec_k=2, ec_m=1)
+        hit = build_cluster(spec)
+        _CRUSH_CACHE["cluster_map"] = hit
+    return hit
+
+
+def _cluster_rule_built(pool_id: int, cache_key: str) -> Built:
+    import numpy as np
+
+    hit = _CRUSH_CACHE.get(cache_key)
+    if hit is None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..crush.bulk import CompiledCrushMap, compile_rule
+
+        m = _cluster_map()
+        pool = m.pools[pool_id]
+        cm = CompiledCrushMap(m.crush)
+        fn = compile_rule(cm, pool.crush_rule, pool.size)
+        jf = jax.jit(jax.vmap(fn, in_axes=(0, None)))
+        wv = jnp.asarray(np.asarray(m.osd_weight, dtype=np.int64))
+        xs = jnp.asarray(np.asarray(pool.pps_all()[:8], dtype=np.int64))
+        hit = (jf, xs, wv, compile_rule)
+        _CRUSH_CACHE[cache_key] = hit
+    jf, xs, wv, anchor = hit
+    return Built(jf, (xs, wv), anchor)
+
+
+def _build_cluster_balancer_round() -> Built:
+    from ..cluster.topology import REPLICATED_POOL
+
+    return _cluster_rule_built(REPLICATED_POOL, "cluster_balancer")
+
+
+def _build_cluster_storm_reeval() -> Built:
+    from ..cluster.topology import EC_POOL
+
+    return _cluster_rule_built(EC_POOL, "cluster_storm")
+
+
+def _build_cluster_rateless_dispatch() -> Built:
+    """The device program one over-planned rateless copy dispatches
+    (cluster/rateless.py::rateless_dispatch_call = the engine's fused
+    decode→re-encode repair program).  Distinct erasure pattern from
+    the engine.fused_repair_call entry, so this audits its own cached
+    program."""
+    import numpy as np
+
+    from ..cluster.rateless import rateless_dispatch_call
+
+    ec = representative_instance("jerasure")
+    n = ec.get_chunk_count()
+    erased = (2,)
+    available = tuple(i for i in range(n) if i != 2)
+    fn = rateless_dispatch_call(ec, available, erased)
+    return Built(fn, (np.zeros((B, len(available), C), np.uint8),),
+                 rateless_dispatch_call)
+
+
 def _build_crc_batch() -> Built:
     import numpy as np
 
@@ -596,6 +675,19 @@ def registry() -> Tuple[EntryPoint, ...]:
                    trace_budget=16),
         EntryPoint("serve.batcher", "serve", "host",
                    _build_serve_batcher, allow=None, trace_budget=0),
+        # the cluster plane (ISSUE 9): balancer-round + storm-re-eval
+        # bulk programs over a topology-generated 4-level map, and the
+        # rateless over-planned dispatch (the fused repair program a
+        # first-k copy runs) — all warm == 0 like every jit entry
+        EntryPoint("cluster.balancer_round", "cluster", "jit",
+                   _build_cluster_balancer_round,
+                   allow=CRUSH_BULK_PRIMS, trace_budget=24),
+        EntryPoint("cluster.storm_reeval", "cluster", "jit",
+                   _build_cluster_storm_reeval,
+                   allow=CRUSH_BULK_PRIMS, trace_budget=24),
+        EntryPoint("cluster.rateless_dispatch", "cluster", "jit",
+                   _build_cluster_rateless_dispatch,
+                   allow=GF_XLA_PRIMS, trace_budget=16),
     ]
     return tuple(entries)
 

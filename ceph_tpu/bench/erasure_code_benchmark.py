@@ -132,7 +132,7 @@ class ErasureCodeBench:
         ap.add_argument("-w", "--workload", default="encode",
                         choices=["encode", "decode", "degraded",
                                  "repair-batched", "recovery-churn",
-                                 "serving", "multichip"])
+                                 "serving", "multichip", "cluster"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -161,6 +161,25 @@ class ErasureCodeBench:
         ap.add_argument("--concurrency", type=int, default=64,
                         help="serving workload: closed-loop in-flight "
                              "window")
+        ap.add_argument("--osds", type=int, default=1000,
+                        help="cluster workload: synthetic cluster "
+                             "device count (ClusterSpec.sized; "
+                             "--device host downscales to keep the "
+                             "tunnel-down error path in seconds)")
+        ap.add_argument("--cluster-pgs", type=int, default=1024,
+                        help="cluster workload: replicated pool "
+                             "pg_num (the EC pool rides at 1/8)")
+        ap.add_argument("--storm-events", type=int, default=40,
+                        help="cluster workload: MapChurn storm epoch "
+                             "budget")
+        ap.add_argument("--redundancy", type=int, default=2,
+                        help="cluster workload: rateless over-"
+                             "planning factor r (1 = no over-"
+                             "planning, the straggler-exposed "
+                             "control)")
+        ap.add_argument("--slow-factor", type=float, default=10.0,
+                        help="cluster workload: the injected "
+                             "straggler's slowdown on shard 0")
         ap.add_argument("-E", "--erasures-generation", default="random",
                         choices=["random", "exhaustive"], dest="erasures_generation")
         ap.add_argument("--erased", action="append", type=int, default=None,
@@ -999,6 +1018,139 @@ class ErasureCodeBench:
         res["verified"] = True
         return res
 
+    # -- cluster (the 10k-OSD cluster plane: storm → balance →
+    # rateless recover from one seed — ceph_tpu/cluster/, ISSUE 9) -----
+
+    def cluster(self) -> dict:
+        """Cluster-plane numbers: a seeded synthetic cluster
+        (--osds devices, ClusterSpec.sized) takes a --storm-events
+        MapChurn storm through the incremental path (full-cluster
+        remap convergence measured per epoch on the bulk evaluator,
+        pinned equivalent to a rebuilt map and a catch_up replay),
+        the balancer loop closes on device to max deviation <= 1,
+        and a rateless first-k recovery (--redundancy copies across
+        the mesh shards) heals --batch damaged objects under an
+        injected straggler (shard 0 at --slow-factor), byte-verified
+        and compared against the same schedule with no straggler —
+        the p99 ratio IS the straggler-tolerance claim.  --device
+        host runs the identical loop over the host mapper at a
+        downscaled size (the tunnel-down error path)."""
+        from ..chaos import ShardErasure, Straggler, inject
+        from ..cluster import (ClusterSpec, balance_cluster,
+                               build_cluster, rateless_recover,
+                               run_churn_storm,
+                               verify_storm_equivalence)
+        from ..cluster.rateless import plan_assignments, \
+            simulate_first_k
+        from ..cluster.topology import EC_POOL
+        from ..codes.stripe import HashInfo, StripeInfo
+        from ..codes.stripe import encode as stripe_encode
+        from ..recovery import healed
+        a = self.args
+        host = a.device == "host"
+        # the host engine walks the python mapper per pg per epoch —
+        # the downscale keeps the tunnel-down error path in seconds
+        # while running the identical loop
+        n_osds = min(a.osds, 120) if host else a.osds
+        pgs = min(a.cluster_pgs, 128) if host else a.cluster_pgs
+        events = min(a.storm_events, 6) if host else a.storm_events
+        engine = "host" if host else "bulk"
+        measure_every = 2 if host else 1
+        spec = ClusterSpec.sized(
+            n_osds, seed=a.seed, replicated_pg_num=pgs,
+            ec_pg_num=max(32, pgs // 8))
+        m = build_cluster(spec)
+
+        ec = self._instance()
+        n = ec.get_chunk_count()
+        k = ec.get_data_chunk_count()
+        chunk_size = ec.get_chunk_size(a.size)
+        width = k * chunk_size
+        sinfo = StripeInfo(k, width)
+        rng = np.random.default_rng(a.seed)
+        n_objects = max(4, a.batch)
+        objects, stores, hinfos = [], [], []
+        for i in range(n_objects):
+            obj = rng.integers(0, 256, size=width,
+                               dtype=np.uint8).tobytes()
+            shards = stripe_encode(sinfo, ec, obj)
+            hinfo = HashInfo(n)
+            hinfo.append(0, shards)
+            # one shared erasure pattern (shard 1): one pattern batch,
+            # one fused dispatch — and the control sim below can
+            # reconstruct the unit work exactly
+            st, _ = inject(shards, [ShardErasure(shards=[1])],
+                           seed=a.seed + i, chunk_size=chunk_size)
+            objects.append(shards)
+            stores.append(st)
+            hinfos.append(hinfo)
+
+        from ..chaos import MapChurn
+        churn = MapChurn(seed=a.seed + 1, max_down=8, fire_every=1,
+                         max_events=events)
+        lat = _LatTimer()
+        begin = time.perf_counter()
+        storm = lat.run(lambda: run_churn_storm(
+            m, churn=churn, events=events, engine=engine,
+            measure_every=measure_every))
+        verify_storm_equivalence(
+            m, churn, lambda: build_cluster(spec), engine=engine,
+            scalar_samples=4)
+        bal = lat.run(lambda: balance_cluster(
+            m, max_deviation=1.0, engine=engine))
+        straggler = Straggler(seed=a.seed + 2,
+                              slow={0: a.slow_factor})
+        rec, rr = lat.run(lambda: rateless_recover(
+            sinfo, ec, m, EC_POOL, 5, stores, hinfos,
+            redundancy=a.redundancy, straggler=straggler,
+            seed=a.seed + 3, device=not host))
+        elapsed = time.perf_counter() - begin
+        if not rec.converged or rec.unrecoverable:
+            raise RuntimeError(
+                f"cluster: recovery failed: {rec.to_dict()}")
+        if not healed(stores, objects):
+            raise RuntimeError("cluster: data loss after rateless "
+                               "recovery")
+        # no-straggler control: the SAME plan/work simulated on a
+        # clean service model — the denominator of the p99 claim
+        # every object lost exactly shard 1 of chunk_size bytes, so
+        # each unit's work matches rateless_recover's classification
+        work = [chunk_size / float(1 << 16)] * rr.n_units
+        plan = plan_assignments(rr.n_units, rr.n_shards,
+                                rr.redundancy, seed=a.seed + 3)
+        baseline = simulate_first_k(
+            plan, Straggler(seed=a.seed + 2), work)
+        import numpy as _np
+        base_p99 = float(_np.percentile(
+            _np.asarray(baseline.completion_s), 99)) \
+            if baseline.completion_s else 0.0
+
+        res = self._result("cluster", elapsed,
+                           width * n_objects, lat)
+        res["osds"] = spec.n_osds
+        res["total_pgs"] = sum(p.pg_num for p in m.pools.values())
+        res["engine"] = engine
+        res["storm_events"] = storm.events + storm.drain_events
+        res["remap_convergence_epochs"] = storm.epochs_to_quiescence
+        res["remapped_total"] = storm.total_remapped
+        res["mean_remap_fraction"] = round(
+            storm.mean_remap_fraction, 6)
+        res["balancer_iterations"] = bal.iterations
+        res["balancer_moves"] = bal.moves
+        res["balancer_converged"] = bal.converged
+        res["balancer_max_dev_final"] = round(bal.max_dev_final, 4)
+        res["balancer_remap_fraction"] = round(bal.remap_fraction, 6)
+        res["redundancy"] = rr.redundancy
+        res["n_shards"] = rr.n_shards
+        res["p99_recovery_ms"] = round(rr.p99_s * 1e3, 4)
+        res["p99_baseline_ms"] = round(base_p99 * 1e3, 4)
+        res["p99_ratio"] = (round(rr.p99_s / base_p99, 4)
+                            if base_p99 > 0 else None)
+        res["straggler_reassignments"] = \
+            rr.schedule.straggler_reassignments if rr.schedule else 0
+        res["verified"] = True
+        return res
+
     def _run_workload(self) -> dict:
         if self.args.workload == "encode":
             return self.encode()
@@ -1012,6 +1164,8 @@ class ErasureCodeBench:
             return self.serving()
         if self.args.workload == "multichip":
             return self.multichip()
+        if self.args.workload == "cluster":
+            return self.cluster()
         return self.decode()
 
 

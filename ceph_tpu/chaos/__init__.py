@@ -17,6 +17,7 @@ from .adversaries import (  # noqa: F401
     CrashPoint,
     InjectedCrash,
     MapChurn,
+    Straggler,
 )
 from .injectors import (  # noqa: F401
     BitFlip,

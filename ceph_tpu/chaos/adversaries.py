@@ -183,4 +183,34 @@ class MapChurn:
         return "reweight", {"new_weight": {osd: w}}
 
 
-__all__ = ["CRASH_SITES", "CrashPoint", "InjectedCrash", "MapChurn"]
+@dataclass
+class Straggler:
+    """Seeded per-shard service-rate adversary (ISSUE 9, the
+    rateless-recovery torture axis): shard ``s`` completes one unit of
+    decode work of size ``work`` in ``base * work * factor(s) *
+    (1 + jitter)`` seconds, where ``factor`` is 1.0 except for the
+    shards named in ``slow`` (the canonical scenario: one shard 10×
+    slower, ``slow={0: 10.0}``) and the jitter draw is a pure function
+    of (seed, shard, unit) — so any (seed, scenario) pair replays the
+    whole completion schedule byte-identically, like every other
+    adversary in this module.  No wall clock, no threads: the rateless
+    planner (cluster/rateless.py) consumes these times in a
+    deterministic discrete-event schedule."""
+
+    seed: int = 0
+    slow: Dict[int, float] = field(default_factory=dict)
+    jitter: float = 0.05
+    base: float = 1.0      # seconds per unit of work at factor 1.0
+
+    def factor(self, shard: int) -> float:
+        return float(self.slow.get(int(shard), 1.0))
+
+    def service_time(self, shard: int, unit: int,
+                     work: float = 1.0) -> float:
+        rng = np.random.default_rng((self.seed, int(shard), int(unit)))
+        j = 1.0 + self.jitter * float(rng.random())
+        return self.base * float(work) * self.factor(shard) * j
+
+
+__all__ = ["CRASH_SITES", "CrashPoint", "InjectedCrash", "MapChurn",
+           "Straggler"]

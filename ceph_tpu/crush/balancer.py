@@ -116,7 +116,8 @@ def rule_weight_osd_map(cmap: CrushMap, ruleno: int) -> np.ndarray:
 
 
 def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
-                   max_iterations: int = 100, engine: str = "bulk"
+                   max_iterations: int = 100, engine: str = "bulk",
+                   on_iteration=None
                    ) -> Dict[Tuple[int, int], List[Tuple[int, int]]]:
     """Propose (and apply to ``m``) pg_upmap_items entries flattening
     per-osd replica counts.  Returns the new entries.
@@ -127,7 +128,19 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
     its rule's TAKE subtree can reach (get_rule_weight_osd_map), which
     is OSDMap::calc_pg_upmaps' only_pools behavior on multi-root /
     device-class maps.  Done when every osd's count is within
-    ``max_deviation`` of its target or no further legal move exists."""
+    ``max_deviation`` of its target or no further legal move exists.
+
+    ``on_iteration(i, dev)``: observer called at the top of every
+    iteration with the per-osd deviation vector (read-only) — the
+    cluster balance loop's convergence trajectory hook.
+
+    Scaling: stage-1 CRUSH placement is evaluated ONCE per pool
+    (``engine`` selects device/sharded/host — the pipeline the device
+    loop closes over) and cached; an applied move re-derives only the
+    moved pg's row host-side (OSDMap.up_row_from_raw — upmap layers
+    apply after stage 1, so the cache never staled) and updates the
+    per-osd counts incrementally.  At 10k OSDs this turns the old
+    O(pg_num) full re-evaluate + recount per probe into O(width)."""
     if pool_id is None:
         pool_ids = sorted(m.pools)
     elif isinstance(pool_id, int):
@@ -165,17 +178,28 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
 
     changes: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
 
-    def pool_counts(up):
+    def row_counts(row):
+        return [int(o) for o in row
+                if o != CRUSH_ITEM_NONE and int(o) >= 0]
+
+    # evaluate every pool's raw CRUSH placement ONCE (the expensive
+    # stage — one bulk device call per pool); the sparse override
+    # layers apply after it, so an applied move only re-derives the
+    # moved pg's row from the cached raw result
+    raws: Dict[int, np.ndarray] = {}
+    ppss: Dict[int, np.ndarray] = {}
+    ups: Dict[int, np.ndarray] = {}
+    counts = np.zeros(m.max_osd, dtype=np.float64)
+    placed_by_pool: Dict[int, int] = {}
+    for pid in pool_ids:
+        raws[pid], ppss[pid] = m.pg_to_raw_bulk(pid, engine=engine)
+        up = m.pg_to_up_bulk(pid, engine=engine, raw=raws[pid],
+                             pps=ppss[pid])[0]
+        ups[pid] = up
         flat = up.ravel()
         placed = flat[(flat != CRUSH_ITEM_NONE) & (flat >= 0)]
-        return np.bincount(placed, minlength=m.max_osd), len(placed)
-
-    # evaluate every pool once; per iteration only the pool whose
-    # upmap just changed is re-evaluated and re-counted (the
-    # evaluation is the expensive part)
-    ups = {pid: m.pg_to_up_bulk(pid, engine=engine)[0]
-           for pid in pool_ids}
-    counts_by_pool = {pid: pool_counts(up) for pid, up in ups.items()}
+        counts += np.bincount(placed, minlength=m.max_osd)
+        placed_by_pool[pid] = len(placed)
     # each pool's replicas spread over ITS rule's reachable osds; the
     # aggregate target is the sum of per-pool targets (the only_pools
     # aggregation upstream does per-pool via pgs_by_osd + rule weight
@@ -184,14 +208,34 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
     target = np.zeros(m.max_osd, dtype=np.float64)
     for pid in pool_ids:
         target += (rule_w[pid] / rule_w[pid].sum()
-                   * counts_by_pool[pid][1])
-    for _ in range(max_iterations):
-        counts = np.zeros(m.max_osd, dtype=np.float64)
-        for c, _n in counts_by_pool.values():
-            counts += c
+                   * placed_by_pool[pid])
+
+    def apply_move(pid: int, ps: int) -> None:
+        """Incremental refresh: overlay the moved pg's cached raw row
+        and swap its count contribution — byte-identical to a full
+        re-evaluate (stage 1 is upmap-invariant; the overlay IS the
+        bulk path's own sparse-override stage)."""
+        pool = m.pools[pid]
+        up = ups[pid]
+        for o in row_counts(up[ps]):
+            counts[o] -= 1
+        u, _prim = m.up_row_from_raw(pool, ps, raws[pid][ps],
+                                     int(ppss[pid][ps]))
+        if len(u) > up.shape[1]:
+            wider = np.full((pool.pg_num, len(u)), CRUSH_ITEM_NONE,
+                            np.int32)
+            wider[:, :up.shape[1]] = up
+            ups[pid] = up = wider
+        up[ps] = u + [CRUSH_ITEM_NONE] * (up.shape[1] - len(u))
+        for o in row_counts(u):
+            counts[o] += 1
+
+    for it in range(max_iterations):
         dev = counts - target
         # ignore osds no pool can reach
         dev[target == 0] = 0.0
+        if on_iteration is not None:
+            on_iteration(it, dev)
         if dev.max() <= max_deviation and dev.min() >= -max_deviation:
             break
         over = int(np.argmax(dev))
@@ -208,8 +252,7 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
                 entry = m.pg_upmap_items.setdefault(key, [])
                 entry.append((over, under))
                 changes[key] = list(entry)
-                ups[pid] = m.pg_to_up_bulk(pid, engine=engine)[0]
-                counts_by_pool[pid] = pool_counts(ups[pid])
+                apply_move(pid, ps)
                 break
         if move is None:
             break

@@ -314,19 +314,13 @@ class OSDMap:
 
     # -- bulk path: every pg of a pool in one device call ----------------
 
-    def pg_to_up_bulk(self, pool_id: int, engine: str = "bulk"
-                      ) -> Tuple[np.ndarray, np.ndarray]:
-        """(up (pg_num, size) int32 with NONE holes kept positional,
-        up_primary (pg_num,)) for every pg of the pool.
-
-        Raw placements run through the fused device evaluator
-        (crush/bulk.py, engine="bulk"), the same program sharded over
-        every visible device (engine="sharded",
-        parallel/sharded_crush.py), or the host mapper
-        (engine="host"); the sparse upmap/affinity layers are then
-        applied host-side, mirroring the scalar pipeline exactly.
-        pg_temp/primary_temp (the acting overrides) are NOT applied
-        here — see pg_to_up_acting_bulk."""
+    def pg_to_raw_bulk(self, pool_id: int, engine: str = "bulk"
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Stage 1 for the whole pool: (raw (pg_num, W) int64 with
+        positional NONE holes, pps (pg_num,)).  Exposed separately so
+        callers that mutate ONLY the sparse override layers — the
+        balancer's move loop — can cache it and re-derive single rows
+        host-side (up_row_from_raw) without re-evaluating CRUSH."""
         pool = self.pools[pool_id]
         pps = pool.pps_all()
         if engine == "sharded":
@@ -350,7 +344,47 @@ class OSDMap:
                                   pool.size, weight=list(self.osd_weight),
                                   choose_args=self._choose_args())
                 raw_arr[i, :len(r)] = r
-        raw_arr = np.asarray(raw_arr, dtype=np.int64)
+        return np.asarray(raw_arr, dtype=np.int64), pps
+
+    def up_row_from_raw(self, pool: PGPool, ps: int, raw_row,
+                        pps_val: int) -> Tuple[List[int], int]:
+        """Scalar stages 2–4 over ONE pg's cached raw placement:
+        (up list, up_primary).  The sparse-override path of
+        pg_to_up_bulk and the balancer's incremental row refresh share
+        this — the raw CRUSH result is invariant under upmap edits, so
+        a move only ever needs this host-side overlay."""
+        row = [int(o) for o in raw_row]
+        if pool.can_shift_osds():
+            # replicated raw results are variable-length; drop the
+            # array padding (EC keeps positional NONE holes)
+            row = [o for o in row if o != CRUSH_ITEM_NONE]
+        raw = self._apply_upmap(pool, pool.raw_pg_to_pg(ps), row)
+        u = self._raw_to_up_osds(pool, raw)
+        return self._apply_primary_affinity(int(pps_val), pool, u)
+
+    def pg_to_up_bulk(self, pool_id: int, engine: str = "bulk",
+                      raw: Optional[np.ndarray] = None,
+                      pps: Optional[np.ndarray] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """(up (pg_num, size) int32 with NONE holes kept positional,
+        up_primary (pg_num,)) for every pg of the pool.
+
+        Raw placements run through the fused device evaluator
+        (crush/bulk.py, engine="bulk"), the same program sharded over
+        every visible device (engine="sharded",
+        parallel/sharded_crush.py), or the host mapper
+        (engine="host"); the sparse upmap/affinity layers are then
+        applied host-side, mirroring the scalar pipeline exactly.
+        pg_temp/primary_temp (the acting overrides) are NOT applied
+        here — see pg_to_up_acting_bulk.  ``raw``/``pps``: a cached
+        pg_to_raw_bulk result to overlay instead of re-evaluating
+        (upmap layers apply AFTER stage 1, so the cache stays valid
+        across upmap edits)."""
+        pool = self.pools[pool_id]
+        if raw is None or pps is None:
+            raw_arr, pps = self.pg_to_raw_bulk(pool_id, engine=engine)
+        else:
+            raw_arr = np.asarray(raw, dtype=np.int64)
 
         # sparse layer: the few pgs with upmap entries take the scalar
         # stages (and may widen the arrays past pool.size)
@@ -362,16 +396,8 @@ class OSDMap:
         # fold only matters for raw seeds beyond pg_num), so pgs with
         # upmap entries are exactly the entry seeds themselves
         for ps in sorted(t for t in touched if 0 <= t < pool.pg_num):
-            pg_seed = ps
-            row = [int(o) for o in raw_arr[ps]]
-            if pool.can_shift_osds():
-                # replicated raw results are variable-length; drop the
-                # array padding (EC keeps positional NONE holes)
-                row = [o for o in row if o != CRUSH_ITEM_NONE]
-            raw = self._apply_upmap(pool, pg_seed, row)
-            u = self._raw_to_up_osds(pool, raw)
-            u, prim = self._apply_primary_affinity(int(pps[ps]), pool, u)
-            overrides[ps] = (u, prim)
+            overrides[ps] = self.up_row_from_raw(pool, ps, raw_arr[ps],
+                                                 int(pps[ps]))
 
         up, up_primary = self._bulk_up_from_raw(pool, raw_arr, pps)
         width = max([up.shape[1]]

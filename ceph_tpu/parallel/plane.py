@@ -202,6 +202,15 @@ def mesh_plane(n_devices: Optional[int] = None):
             _active, _env_resolved = prev, prev_resolved
 
 
+def shard_count(default: int = 1) -> int:
+    """Stripe-work shards on the active data plane (``default`` when
+    none is active) — the rateless recovery planner's fan-out width:
+    over-planned decode copies spread across exactly the devices the
+    engine tier shards over (cluster/rateless.py)."""
+    plane = data_plane()
+    return plane.n_devices if plane is not None else default
+
+
 def plane_topology(plane: Optional[DataPlane] = None) -> Optional[list]:
     """[dp, tp]-style mesh shape for bench metadata, or None."""
     if plane is None:

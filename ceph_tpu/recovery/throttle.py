@@ -9,31 +9,65 @@ their turn.  Here the orchestrator dispatches in rounds; the throttle
 is the per-round admission control: an op is admitted only when EVERY
 target OSD it writes to has a free slot, otherwise it defers to the
 next round (counted — the report proves the bound held).
+
+Weighted limits (ISSUE 9): the rateless recovery plan measures
+per-shard completion skew — which devices are actually slow — and
+feeds it back as a per-OSD weight vector (``set_osd_weights``).  A
+weighted OSD's round budget scales down from ``max_inflight``
+(floored at one slot, so a slow-but-alive device still makes
+progress and a wide op spanning it can never starve forever); an
+unweighted OSD keeps the full global limit, so the pre-weights
+behavior — and every existing test — is unchanged.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Iterable
+from typing import Dict, Iterable, Mapping
 
 
 @dataclass
 class OsdRecoveryThrottle:
-    """Admit at most ``max_inflight`` recovery write-ops per OSD per
-    round.  ``admit(targets)`` reserves a slot on every target OSD or
-    none (all-or-nothing, so a wide op cannot starve by partially
+    """Admit at most ``limit_for(osd)`` recovery write-ops per OSD per
+    round (``max_inflight`` scaled by the osd's weight, if any).
+    ``admit(targets)`` reserves a slot on every target OSD or none
+    (all-or-nothing, so a wide op cannot starve by partially
     reserving); ``reset_round()`` opens the next round."""
 
     max_inflight: int = 4
+    # osd -> relative speed in (0, 1]; absent = 1.0 (full limit).
+    # Fed by rateless completion skew (cluster/rateless.py).
+    osd_weights: Dict[int, float] = field(default_factory=dict)
     inflight: Dict[int, int] = field(default_factory=dict)
     deferrals: int = 0        # lifetime count of refused admissions
     admitted: int = 0         # lifetime count of granted admissions
     peak: int = 0             # max per-osd admissions ever observed
 
+    def limit_for(self, osd: int) -> int:
+        """This OSD's per-round admission budget: max_inflight scaled
+        by its weight (clamped to (0, 1]), never below one slot — a
+        slow device is throttled, not starved."""
+        if self.max_inflight <= 0:
+            return 0
+        w = self.osd_weights.get(int(osd))
+        if w is None or w >= 1.0:
+            return self.max_inflight
+        return max(1, int(round(self.max_inflight * max(w, 0.0))))
+
+    def set_osd_weights(self, weights: Mapping[int, float]) -> None:
+        """Install the per-OSD weight vector (replaces any previous
+        one).  Values clamp into (0, 1] at use; 1.0 entries are
+        dropped (identical to absent)."""
+        self.osd_weights = {int(o): float(w) for o, w in weights.items()
+                            if float(w) < 1.0}
+        from ..telemetry import metrics as tel
+        tel.event("recovery_throttle_weights",
+                  weighted_osds=len(self.osd_weights))
+
     def admit(self, targets: Iterable[int]) -> bool:
         from ..telemetry import metrics as tel
         osds = [int(o) for o in targets]
-        if any(self.inflight.get(o, 0) >= self.max_inflight
+        if any(self.inflight.get(o, 0) >= self.limit_for(o)
                for o in osds):
             self.deferrals += 1
             tel.counter("recovery_throttle_deferrals")

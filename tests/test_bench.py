@@ -261,11 +261,13 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    assert bench.METRIC_VERSION == 5
+    assert bench.METRIC_VERSION == 6
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
                         lambda host_only=False, requests=None: {})
+    monkeypatch.setattr(bench, "_cluster_rows",
+                        lambda host_only=False: {})
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["metric_version"] == bench.METRIC_VERSION
     # metric_version 3: every emitted line carries the telemetry blob
@@ -274,6 +276,11 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     # (GB/s-under-SLO + latency percentiles; docs/SERVING.md)
     assert "serving_rows" in err
     assert dict(bench.SERVING_ROWS)  # at least one declared row
+    # metric_version 6: every line carries the cluster-plane rows
+    # (remap convergence, balancer iterations, p99 vs no-straggler
+    # control; docs/CLUSTER.md)
+    assert "cluster_rows" in err
+    assert dict(bench.CLUSTER_ROWS)  # at least one declared row
     # metric_version 5: every line carries the device topology, so a
     # tunnel-down host-only round is self-describing (ISSUE 8); the
     # probe failed here, so the error line says "no device"
@@ -368,6 +375,35 @@ def test_multichip_workload_rejects_host_device():
     with pytest.raises(SystemExit):
         run_bench(["--workload", "multichip", "--device", "host",
                    "--size", "4096"])
+
+
+def test_cluster_workload_host():
+    """--workload cluster (metric_version 6): the seeded storm →
+    balance → rateless-recover scenario over a synthetic cluster —
+    storm equivalence and byte-identical heal verified in-workload,
+    remap convergence / balancer / p99-vs-control fields reported."""
+    res = run_bench(["--workload", "cluster", "--plugin", "jerasure",
+                     "--parameter", "technique=reed_sol_van",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "4096", "--batch", "4",
+                     "--osds", "60", "--cluster-pgs", "64",
+                     "--storm-events", "4", "--device", "host",
+                     "--seed", "11"])
+    assert res["workload"] == "cluster"
+    assert res["verified"] is True
+    assert res["engine"] == "host"
+    assert res["osds"] >= 60
+    for f in ("remap_convergence_epochs", "mean_remap_fraction",
+              "balancer_iterations", "balancer_max_dev_final",
+              "p99_recovery_ms", "p99_baseline_ms",
+              "straggler_reassignments", "redundancy"):
+        assert f in res, f
+    assert res["storm_events"] >= 4
+    assert res["balancer_iterations"] >= 1
+    assert res["p99_recovery_ms"] > 0
+    if res["p99_ratio"] is not None:
+        # the rateless bound: 10x straggler, r=2 -> within 2x control
+        assert res["p99_ratio"] <= 2.0
 
 
 def test_serving_workload_host():

@@ -275,6 +275,49 @@ def test_throttle_bounds_per_osd_admissions():
     assert rep.rounds >= 3
 
 
+def test_throttle_weighted_limits_scale_down_not_starve():
+    """ISSUE 9 satellite: a per-OSD weight vector (rateless completion
+    skew) scales the per-round budget per device — floored at one
+    slot (a slow device is throttled, never starved) — while
+    unweighted OSDs keep the full limit and admission stays
+    all-or-nothing."""
+    t = OsdRecoveryThrottle(max_inflight=4)
+    t.set_osd_weights({0: 0.1, 1: 0.5, 2: 1.0, 3: 2.0})
+    assert t.limit_for(0) == 1              # floored, not zero
+    assert t.limit_for(1) == 2
+    assert t.limit_for(2) == 4              # 1.0 == unweighted
+    assert t.limit_for(3) == 4              # >1 clamps to the limit
+    assert t.limit_for(9) == 4              # absent = full limit
+    # all-or-nothing across mixed limits: the wide op spanning the
+    # slow osd admits only while osd.0's single slot is free
+    assert t.admit([0, 9])
+    assert not t.admit([0, 8])              # osd.0 exhausted
+    assert t.admit([8])                     # unweighted osd unaffected
+    assert t.inflight.get(8) == 1 and t.inflight.get(0) == 1
+    t.reset_round()
+    assert t.admit([0, 8])                  # fresh round, fresh slots
+    # max_inflight=0 still admits nothing, weights or not
+    t0 = OsdRecoveryThrottle(max_inflight=0)
+    t0.set_osd_weights({0: 0.5})
+    assert not t0.admit([0]) and not t0.admit([5])
+
+
+def test_throttle_weighted_recovery_still_heals():
+    """The orchestrator under a weighted throttle converges
+    byte-identical — the weights only move WHEN writes are admitted,
+    never whether they complete."""
+    faults = [([0], [])] * 4
+    sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
+        n_objects=4, faults=faults)
+    throttle = OsdRecoveryThrottle(max_inflight=2)
+    # weight every osd slow: every device drops to the 1-slot floor
+    throttle.set_osd_weights({o: 0.01 for o in range(osdmap.max_osd)})
+    rep = recover(sinfo, ec, osdmap, stores, hinfos, throttle=throttle)
+    assert rep.converged and healed(stores, originals)
+    assert throttle.peak <= 1               # the floor held
+    assert rep.throttle_deferrals >= 1
+
+
 def test_deadline_expired_op_reported_not_retried():
     sinfo, ec, osdmap, originals, stores, hinfos = make_pg(
         n_objects=2, faults=[([0], [])])

@@ -99,6 +99,12 @@ run_row "row 8: multichip — mesh-sharded encode over every visible device (ISS
     -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
     -s $((1<<20)) --workload multichip --batch 64 --iterations 8 --json
 
+run_row "row 9: cluster plane — seeded storm -> balance -> rateless recover over a 1k-OSD synthetic cluster (ISSUE 9; remap convergence, balancer iterations, p99 recovery vs no-straggler control)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=4 -P m=2 \
+    -s $((1<<16)) --workload cluster --osds 1000 --cluster-pgs 1024 \
+    --storm-events 40 --batch 8 --json
+
 run_row "row 5: 1M-PG bulk CRUSH sweep on device" \
     python tools/bulk_crush_row.py
 

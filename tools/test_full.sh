@@ -54,6 +54,30 @@ python tools/serve_demo.py --requests 48 --validate >/dev/null \
     || { echo "serve_demo: serving gate failed"; exit 1; }
 python tools/serve_demo.py --erasures 4 >/dev/null 2>&1
 [ $? -eq 2 ] || { echo "serve_demo: expected unrecoverable rc 2"; exit 1; }
+# Cluster-plane gates (ISSUE 9 / docs/CLUSTER.md): the seeded
+# storm -> balance -> rateless-recover scenario must hold every gate
+# (storm incremental == rebuilt == catch_up, balancer converged to
+# max deviation <= 1 with device-loop proposals byte-identical to the
+# host loop, zero data loss under the injected straggler) at rc 0,
+# and a past-budget erasure mix must exit with the structured
+# unrecoverable report (rc 2).
+python tools/cluster_demo.py --osds 240 --pgs 256 --events 12 \
+    >/dev/null || { echo "cluster_demo: cluster gate failed"; exit 1; }
+python tools/cluster_demo.py --osds 120 --pgs 256 --events 8 \
+    --verify-host-loop >/dev/null \
+    || { echo "cluster_demo: host-loop identity gate failed"; exit 1; }
+python tools/cluster_demo.py --osds 120 --pgs 128 --events 6 \
+    --erasures 3 >/dev/null 2>&1
+[ $? -eq 2 ] || { echo "cluster_demo: expected unrecoverable rc 2"; exit 1; }
+# The 10k-OSD acceptance scenario on the simulated 8-device mesh
+# (ISSUE 9): the same end-to-end run at full scale, the bulk
+# evaluator riding an 8-way forced-CPU data plane.
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    CEPH_TPU_MESH=auto \
+    python tools/cluster_demo.py --osds 10000 --pgs 2048 --events 30 \
+    --measure-every 5 >/dev/null \
+    || { echo "cluster_demo: 10k simulated-mesh gate failed"; exit 1; }
 # Simulated-mesh gate (ISSUE 8 / docs/PERF.md "Multi-chip data
 # plane"): the sharded engine tier must hold on an 8-way virtual CPU
 # mesh — trace audit of the sharded entry points (shard_map program
