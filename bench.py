@@ -81,7 +81,17 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # deviation, p99 recovery ms vs the no-straggler control (the ratio
 # IS the rateless claim) and straggler_reassignments; host-only on
 # the tunnel-down error path at a downscaled size, same loop.
-METRIC_VERSION = 6
+# v7 (ISSUE 10, device-plane profiler): a `profile_rows` section —
+# per-program cost/roofline attribution for the engine's cached
+# programs (--workload profile; telemetry/profiler.py): XLA
+# cost_analysis FLOPs/bytes joined with measured dispatch latency
+# into achieved GB/s, model-bound GB/s and HBM-roofline utilization %
+# per (plugin, pattern, engine tier, device count).  On the
+# tunnel-down error path the same row runs --device host with the
+# analytic GF(2^8) cost model (source="analytic" — host-only fields,
+# honest provenance).  tools/bench_diff.py is the regression sentinel
+# over this whole trajectory.
+METRIC_VERSION = 7
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -204,6 +214,42 @@ CLUSTER_ROWS = [
       "--device", "jax", "--osds", "1000", "--cluster-pgs", "1024",
       "--storm-events", "40", "--batch", "8", "--seed", "42"]),
 ]
+
+# Profile rows (ISSUE 10): the device-plane profiler over the
+# north-star shape — serve encode/decode + fused repair through the
+# engine's cached programs, per-program cost/roofline attribution
+# joined with measured dispatch latency.  The row's GB/s is the mixed
+# three-program loop (not a headline — the attribution table is the
+# payload); argparse last-wins re-pins --device host on the error
+# path, where the analytic cost model keeps the rows alive.
+PROFILE_ROWS = [
+    ("rs_k8_m3_profile",
+     ["--plugin", "jerasure", "--parameter", "technique=reed_sol_van",
+      "--parameter", "k=8", "--parameter", "m=3",
+      "--size", str(1 << 18), "--workload", "profile",
+      "--device", "jax", "--batch", "16", "--iterations", "4",
+      "-e", "1"]),
+]
+
+
+def _profile_rows(host_only: bool = False) -> dict:
+    rows = {}
+    for name, argv in PROFILE_ROWS:
+        row_argv = list(argv)
+        if host_only:
+            row_argv += ["--device", "host"]
+        try:
+            res = _run(row_argv)
+            row = _row_result(res)
+            row["programs"] = res.get("programs")
+            row["profile_rows"] = res.get("profile_rows")
+            rows[name] = row
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"profile/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
+
 
 CLUSTER_ROW_FIELDS = (
     "osds", "total_pgs", "engine", "storm_events",
@@ -425,6 +471,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "degraded_rows": _degraded_rows(iterations=1, host_only=True),
         "serving_rows": _serving_rows(host_only=True, requests=96),
         "cluster_rows": _cluster_rows(host_only=True),
+        "profile_rows": _profile_rows(host_only=True),
         "last_good": _read_last_good(),
         "telemetry": _telemetry_blob(),
         **_audit_meta(),
@@ -626,6 +673,7 @@ def main() -> int:
         "serving_rows": _serving_rows(),
         "multichip_rows": _multichip_rows(),
         "cluster_rows": _cluster_rows(),
+        "profile_rows": _profile_rows(),
         "lat_p50_ms": best.get("lat_p50_ms"),
         "lat_p99_ms": best.get("lat_p99_ms"),
         "lat_p999_ms": best.get("lat_p999_ms"),

@@ -593,6 +593,31 @@ def _build_telemetry() -> Built:
     return Built(telemetry_selftest, (), telemetry_selftest)
 
 
+def _build_profiler_selftest() -> Built:
+    """The device-plane profiler's attribution join as a host-tier
+    entry (ISSUE 10): capture → observe → roofline rows → schema
+    validation on synthetic analytic costs.  The profiler's whole
+    value is that cost capture never backend-compiles; this sentinel
+    pins the join side of it to ZERO compiles forever (the lower-only
+    capture path is exercised — and compile-counted — by the jit
+    entries it rides)."""
+    from ..telemetry.profiler import profiler_selftest
+
+    return Built(profiler_selftest, (), profiler_selftest)
+
+
+def _build_flight_recorder() -> Built:
+    """The flight recorder as a host-tier entry (ISSUE 10): ring
+    bounding, span-root wiring, post-mortem dump + delta accounting
+    and schema validation on isolated clock-injected instances —
+    ZERO compiles, zero device arrays.  A post-mortem path that
+    touched the device would deadlock exactly when it matters (the
+    device is what just failed)."""
+    from ..telemetry.recorder import flight_recorder_selftest
+
+    return Built(flight_recorder_selftest, (), flight_recorder_selftest)
+
+
 # ----------------------------------------------------------------------
 # THE registry
 
@@ -670,6 +695,11 @@ def registry() -> Tuple[EntryPoint, ...]:
                    _build_crc_batch, allow=None, trace_budget=0),
         EntryPoint("telemetry.selftest", "telemetry", "host",
                    _build_telemetry, allow=None, trace_budget=0),
+        EntryPoint("telemetry.profiler_selftest", "telemetry", "host",
+                   _build_profiler_selftest, allow=None,
+                   trace_budget=0),
+        EntryPoint("telemetry.flight_recorder", "telemetry", "host",
+                   _build_flight_recorder, allow=None, trace_budget=0),
         EntryPoint("serve.dispatch", "serve", "jit",
                    _build_serve_dispatch, allow=GF_XLA_PRIMS,
                    trace_budget=16),

@@ -132,7 +132,8 @@ class ErasureCodeBench:
         ap.add_argument("-w", "--workload", default="encode",
                         choices=["encode", "decode", "degraded",
                                  "repair-batched", "recovery-churn",
-                                 "serving", "multichip", "cluster"])
+                                 "serving", "multichip", "cluster",
+                                 "profile"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -1151,6 +1152,107 @@ class ErasureCodeBench:
         res["verified"] = True
         return res
 
+    # -- profile (the device-plane profiler: per-program cost/roofline
+    # attribution for the engine's cached programs — ISSUE 10,
+    # telemetry/profiler.py, docs/OBSERVABILITY.md) ---------------------
+
+    def profile_workload(self) -> dict:
+        """Cost/roofline attribution workload (metric_version 7):
+        drives the engine's cached programs — serve encode, serve
+        decode and the fused decode→re-encode repair — for the
+        configured plugin, and emits per-program attribution rows
+        joining XLA ``cost_analysis`` (FLOPs, bytes accessed) with the
+        measured dispatch histograms: achieved GB/s, model-bound GB/s
+        at the HBM roofline, utilization %.
+
+        ``--device host`` (the tunnel-down error path) runs the numpy
+        batch surfaces instead and fills the cost side from the
+        analytic GF(2^8) matrix model (``source="analytic"``) — the
+        row structure survives an outage, only the provenance
+        changes."""
+        from ..telemetry import profiler as profmod
+        a = self.args
+        ec = self._instance()
+        n = ec.get_chunk_count()
+        m_ = ec.get_coding_chunk_count()
+        data = self._make_batch(ec)
+        chunk_size = data.shape[2]
+        pat = self._erasure_patterns(ec, n)[0]
+        available = tuple(i for i in range(n) if i not in pat)
+        parity = np.asarray(ec.encode_chunks_batch(data))
+        allchunks = self._place_chunks(ec, data, parity)
+        survivors = np.ascontiguousarray(
+            allchunks[:, np.array(available), :])
+        lat = _LatTimer()
+        plugin_cls = type(ec).__name__
+
+        if a.device == "jax":
+            import jax
+
+            from ..codes.engine import (fused_repair_call,
+                                        serve_dispatch_call)
+            prof = profmod.global_profiler()
+            enc = serve_dispatch_call(ec, "encode")
+            dec = serve_dispatch_call(ec, "decode", available, pat)
+            rep = fused_repair_call(ec, available, pat)
+            denc = jax.device_put(data)
+            dsurv = jax.device_put(survivors)
+            calls = [lambda: enc(denc), lambda: dec(dsurv),
+                     lambda: rep(dsurv)]
+            for fn in calls:            # warm: compile + cost capture
+                jax.block_until_ready(fn())
+            begin = time.perf_counter()
+            for _ in range(a.iterations):
+                for fn in calls:
+                    lat.run(lambda fn=fn: jax.block_until_ready(fn()))
+            elapsed = time.perf_counter() - begin
+            total_bytes = (data.nbytes + 2 * survivors.nbytes) \
+                * a.iterations
+            rows = [r for r in prof.attribution_rows()
+                    if r.get("plugin") == plugin_cls]
+        else:
+            # host tier: numpy batch surfaces + the analytic cost
+            # model — no jax anywhere, so the row survives a wedged
+            # tunnel (bench.py's error path rides this)
+            prof = profmod.ProgramProfiler()
+            ops = [
+                ("encode", m_, ec.get_data_chunk_count(),
+                 lambda: ec.encode_chunks_batch(data)),
+                ("decode", len(pat), len(available),
+                 lambda: ec.decode_chunks_batch(survivors, available,
+                                                pat)),
+            ]
+            for opname, rows_, cols_, fn in ops:
+                key = ("bench.profile", plugin_cls, opname)
+                prof.capture(
+                    key, name=f"host.{opname}", platform="cpu",
+                    cost=profmod.analytic_matrix_cost(
+                        a.batch, rows_, cols_, chunk_size),
+                    arg_bytes=a.batch * cols_ * chunk_size,
+                    plugin=plugin_cls, kind=f"host-{opname}",
+                    pattern="e" + "_".join(map(str, pat)),
+                    engine="host", devices=0, batch=a.batch)
+                fn()                    # warm caches
+            begin = time.perf_counter()
+            for _ in range(a.iterations):
+                for opname, _r, _c, fn in ops:
+                    key = ("bench.profile", plugin_cls, opname)
+                    t0 = time.perf_counter()
+                    fn()
+                    dt = time.perf_counter() - t0
+                    lat.record(dt)
+                    prof.observe(key, dt)
+            elapsed = time.perf_counter() - begin
+            total_bytes = (data.nbytes + survivors.nbytes) \
+                * a.iterations
+            rows = prof.attribution_rows()
+
+        res = self._result("profile", elapsed, total_bytes, lat)
+        res["erasures"] = len(pat)
+        res["programs"] = len(rows)
+        res["profile_rows"] = rows
+        return res
+
     def _run_workload(self) -> dict:
         if self.args.workload == "encode":
             return self.encode()
@@ -1166,6 +1268,8 @@ class ErasureCodeBench:
             return self.multichip()
         if self.args.workload == "cluster":
             return self.cluster()
+        if self.args.workload == "profile":
+            return self.profile_workload()
         return self.decode()
 
 

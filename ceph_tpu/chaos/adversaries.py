@@ -68,6 +68,14 @@ class CrashPoint:
             from ..telemetry import metrics as tel
             tel.counter("chaos_injections", kind="crash")
             tel.event("injected_crash", site=site, hit=self.hits[site])
+            # a recovery crash site is a flight-recorder trigger: the
+            # post-mortem blob freezes the span tree / counters the
+            # "process" died with, before journal replay wipes the
+            # evidence (docs/OBSERVABILITY.md)
+            from ..telemetry import recorder
+            recorder.trip("crash_site",
+                          f"injected crash at {site}",
+                          site=site, hit=self.hits[site])
             raise InjectedCrash(site, self.hits[site])
 
 

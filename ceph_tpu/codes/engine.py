@@ -93,6 +93,16 @@ class PatternCache:
                 tel.event("pattern_cache_budget_exceeded",
                           builds=self.builds,
                           budget=self.recompile_budget)
+                # an armed budget tripping IS a production incident
+                # (pattern churn = a compile storm): freeze the
+                # flight-recorder post-mortem before raising
+                from ..telemetry import recorder
+                recorder.trip(
+                    "recompile_budget",
+                    f"{self.builds} builds > budget "
+                    f"{self.recompile_budget}",
+                    builds=self.builds, budget=self.recompile_budget,
+                    key=str(key))
                 raise RuntimeError(
                     f"pattern-cache recompile budget exceeded: "
                     f"{self.builds} composite builds > "
@@ -167,6 +177,11 @@ def _resolve_mesh(mesh):
     if plane is not None and plane.n_devices < 2:
         return None
     return plane
+
+
+def _profiler():
+    from ..telemetry.profiler import global_profiler
+    return global_profiler()
 
 
 def _shard_program(raw, plane, n_out: int):
@@ -278,6 +293,18 @@ def fused_repair_call(ec, available: Tuple[int, ...],
 
         fn = (jax.jit(raw) if plane is None
               else _shard_program(raw, plane, n_out=2))
+        ndev = plane.n_devices if plane is not None else 1
+        # the PatternCache key IS the program identity (class +
+        # profile + kind + pattern + mesh) — reuse it so two profiles
+        # of one plugin class can never share an attribution row
+        prof_key = ("prof",) + key
+        prof_labels = dict(
+            plugin=type(ec).__name__, kind="fused-repair",
+            profile=",".join(f"{pk}={pv}" for pk, pv in
+                             sorted(ec.get_profile().items())),
+            pattern="e" + "_".join(map(str, erased)),
+            engine="mesh" if plane is not None else "device",
+            devices=ndev)
 
         def timed(stack):
             # host-side dispatch latency histogram.  Tracer inputs
@@ -285,13 +312,28 @@ def fused_repair_call(ec, available: Tuple[int, ...],
             # nothing (a trace-time clock read is fiction) and leave
             # the jaxpr telemetry-free by construction.
             eager = not isinstance(stack, jax.core.Tracer)
-            if eager and plane is not None:
-                tel.counter("engine_mesh_dispatches",
-                            tier="fused-repair",
-                            devices=str(plane.n_devices))
+            prof = _profiler()
+            if eager and tel.enabled():
+                if plane is not None:
+                    tel.counter("engine_mesh_dispatches",
+                                tier="fused-repair",
+                                devices=str(plane.n_devices))
+                # cost-attribution capture (telemetry/profiler.py):
+                # first eager dispatch lowers the program once for
+                # XLA cost_analysis — zero backend compiles, so the
+                # warm==0 sentinel cannot see it
+                # keyed per batch rung: one jit wrapper serves many
+                # stripe-batch shapes, each its own compiled program
+                pk = prof_key + (int(stack.shape[0]),)
+                prof.capture(pk, fn, (stack,),
+                             name="engine.fused_repair",
+                             batch=int(stack.shape[0]), **prof_labels)
+            else:
+                pk = prof_key
             with tel.record_dispatch(
                     "engine_fused_repair_dispatch",
-                    eager=eager, plugin=type(ec).__name__):
+                    eager=eager, plugin=type(ec).__name__), \
+                    prof.timed(pk, eager=eager):
                 return fn(stack)
 
         return timed
@@ -349,19 +391,41 @@ def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
 
         fn = (jax.jit(raw) if plane is None
               else _shard_program(raw, plane, n_out=1))
+        ndev = plane.n_devices if plane is not None else 1
+        # keyed on the PatternCache key: program identity includes
+        # the profile, so rs_k4_m2 and rs_k8_m3 never share a row
+        prof_key = ("prof",) + key
+        prof_labels = dict(
+            plugin=type(ec).__name__, kind=f"serve-{op}",
+            profile=",".join(f"{pk}={pv}" for pk, pv in
+                             sorted(ec.get_profile().items())),
+            pattern="e" + "_".join(map(str, erased)),
+            engine="mesh" if plane is not None else "device",
+            devices=ndev)
 
         def timed(stack):
             # same trace-eagerness discipline as fused_repair_call:
             # record nothing when WE are being traced into a larger
             # program, so jaxprs stay telemetry-free
             eager = not isinstance(stack, jax.core.Tracer)
-            if eager and plane is not None:
-                tel.counter("engine_mesh_dispatches",
-                            tier=f"serve-{op}",
-                            devices=str(plane.n_devices))
+            prof = _profiler()
+            if eager and tel.enabled():
+                if plane is not None:
+                    tel.counter("engine_mesh_dispatches",
+                                tier=f"serve-{op}",
+                                devices=str(plane.n_devices))
+                # keyed per batch rung: one jit wrapper serves many
+                # stripe-batch shapes, each its own compiled program
+                pk = prof_key + (int(stack.shape[0]),)
+                prof.capture(pk, fn, (stack,),
+                             name="engine.serve_dispatch",
+                             batch=int(stack.shape[0]), **prof_labels)
+            else:
+                pk = prof_key
             with tel.record_dispatch(
                     "serve_dispatch", eager=eager,
-                    op=op, plugin=type(ec).__name__):
+                    op=op, plugin=type(ec).__name__), \
+                    prof.timed(pk, eager=eager):
                 return fn(stack)
 
         return timed

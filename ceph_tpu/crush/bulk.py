@@ -1305,15 +1305,37 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
         tel.counter("engine_mesh_dispatches", tier="crush-bulk",
                     devices=str(nd))
     jf = _get_jitted(cm, ruleno, result_max, *rungs[0], plane=plane)
+    # cost-attribution capture for the fused rule program
+    # (telemetry/profiler.py): the first block lowers once for XLA
+    # cost_analysis (zero backend compiles — the jit cache above still
+    # owns compilation), every block dispatch lands in the program's
+    # latency histogram.  Keyed like the jit cache, plus the map size
+    # so a 10k-OSD sweep and a toy map don't share a row.
+    from ..telemetry import metrics as _tel
+    from ..telemetry.profiler import global_profiler
+    prof = global_profiler()
+    prof_key = ("crush.bulk_rule", ruleno, result_max, rungs[0],
+                block, nd, len(wv))
+    captured = not _tel.enabled()
     for s in range(0, n, block):
         e = min(s + block, n)
         xs_b = xs[s:e]
         if e - s < block:
             xs_b = np.concatenate([xs_b, xs_b[:1].repeat(block - (e - s))])
-        o, c, nm = jf(jnp.asarray(xs_b), wv)
-        out[s:e] = np.asarray(o)[:e - s]
-        cnt[s:e] = np.asarray(c)[:e - s]
-        need[s:e] = np.asarray(nm)[:e - s]
+        xs_d = jnp.asarray(xs_b)
+        if not captured:
+            captured = True
+            prof.capture(prof_key, jf, (xs_d, wv),
+                         name="crush.bulk_rule", plugin="crush",
+                         kind="bulk-rule", batch=block,
+                         pattern=f"rule{ruleno}x{result_max}",
+                         engine="mesh" if nd > 1 else "device",
+                         devices=nd)
+        with prof.timed(prof_key, eager=_tel.enabled()):
+            o, c, nm = jf(xs_d, wv)
+            out[s:e] = np.asarray(o)[:e - s]
+            cnt[s:e] = np.asarray(c)[:e - s]
+            need[s:e] = np.asarray(nm)[:e - s]
     redo = np.nonzero(need)[0]
 
     # residue-adaptive rungs: each deeper budget re-dispatches ONLY the

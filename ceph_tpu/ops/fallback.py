@@ -110,7 +110,8 @@ class FallbackPolicy:
         dout("ec", 1, f"backend fallback policy: engine={eng} ({why}){tail}")
         # the log-once transition is ALSO a counter + structured event
         # in the telemetry plane: a tier drop mid-fleet is a metric to
-        # alert on, not just a line someone may have had enabled
+        # alert on, not just a line someone may have had enabled (the
+        # event additionally lands in the flight recorder's ring)
         from ..telemetry import metrics as tel
         tel.counter("fallback_tier_transitions", device=kind, engine=eng)
         tel.event("fallback_tier", device=kind, engine=eng,
@@ -118,6 +119,16 @@ class FallbackPolicy:
                   probe_error=(f"{type(self.probe_error).__name__}: "
                                f"{self.probe_error}"
                                if self.probe_error else None))
+        if eng == "numpy" and not forced:
+            # an UNFORCED drop to the numpy ground-truth tier means no
+            # XLA backend initialized at all — on a deployment that is
+            # an outage, so freeze the post-mortem (the probe error is
+            # exactly the evidence an operator needs)
+            from ..telemetry import recorder
+            recorder.trip(
+                "backend_lost",
+                f"fallback to numpy tier: {tail or why}",
+                device=kind, engine=eng)
 
 
 _global: Optional[FallbackPolicy] = None

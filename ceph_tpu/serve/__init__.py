@@ -26,7 +26,7 @@ entry pins the bookkeeping compile-free).
 """
 
 from .queue import OPS, AdmissionQueue, EcRequest, EcResult
-from .sla import SlaRecorder, SloPolicy
+from .sla import BurnRateMonitor, SlaRecorder, SloPolicy
 from .batcher import LADDER, ContinuousBatcher, rung_for
 from .loadgen import (
     CodecSpec,
@@ -41,6 +41,7 @@ from .loadgen import (
 
 __all__ = [
     "AdmissionQueue",
+    "BurnRateMonitor",
     "CodecSpec",
     "ContinuousBatcher",
     "EcRequest",

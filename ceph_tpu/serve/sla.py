@@ -28,8 +28,9 @@ slack-based firing is tuned against.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..telemetry import LatencyHistogram
 from ..telemetry import metrics as tel
@@ -37,6 +38,87 @@ from .queue import OPS, EcResult
 
 # generous host-scale defaults; serving scenarios set their own
 DEFAULT_DEADLINES = {"encode": 0.200, "decode": 0.200, "repair": 0.500}
+
+# burn-rate defaults (docs/OBSERVABILITY.md "Burn-rate windows"): the
+# SRE error-budget discipline on request-count windows (deterministic
+# under FakeClock — a wall-clock window would make seeded scenarios
+# timing-dependent).  budget = the tolerated steady-state deadline-miss
+# rate; a window trips when its rolling miss rate reaches
+# budget × burn — the short window catches a sharp cliff in ~1 bucket
+# flight, the long window catches a slow leak that never spikes.
+DEFAULT_MISS_BUDGET = 0.02
+DEFAULT_BURN_WINDOWS: Tuple[Tuple[int, float], ...] = (
+    (64, 4.0),     # fast burn: >=8% misses over the last 64 requests
+    (512, 1.5),    # slow burn: >=3% misses over the last 512
+)
+
+
+class BurnRateMonitor:
+    """Rolling-window deadline-miss burn-rate monitor.
+
+    Feeds from :meth:`SlaRecorder.record`; when a window's miss rate
+    reaches ``budget × burn`` (window full — a half-warm window never
+    alarms), the monitor counts ``serve_slo_burn_trips``, emits a
+    structured event, and freezes a flight-recorder post-mortem
+    (telemetry/recorder.py) so the batch composition / padding /
+    queue-depth evidence survives the incident.  Each window re-arms
+    only after its miss rate falls back below threshold — a sustained
+    breach is ONE trip, not one per request.
+    """
+
+    def __init__(self, budget: float = DEFAULT_MISS_BUDGET,
+                 windows: Tuple[Tuple[int, float], ...] =
+                 DEFAULT_BURN_WINDOWS,
+                 flight_dump: bool = True) -> None:
+        if not 0.0 < budget < 1.0:
+            raise ValueError(f"miss budget {budget} must be in (0, 1)")
+        self.budget = budget
+        self.flight_dump = flight_dump
+        self._windows = [{"size": int(s), "burn": float(b),
+                          "buf": deque(maxlen=int(s)), "misses": 0,
+                          "armed": True}
+                         for s, b in windows]
+        self.trips: List[dict] = []
+
+    def record(self, op: str, deadline_met: bool) -> List[dict]:
+        """Fold one served request in; returns the trips it fired."""
+        miss = 0 if deadline_met else 1
+        fired: List[dict] = []
+        for w in self._windows:
+            buf = w["buf"]
+            if len(buf) == buf.maxlen:
+                w["misses"] -= buf[0]
+            buf.append(miss)
+            w["misses"] += miss
+            if len(buf) < buf.maxlen:
+                continue
+            rate = w["misses"] / len(buf)
+            threshold = self.budget * w["burn"]
+            if rate >= threshold:
+                if w["armed"]:
+                    w["armed"] = False
+                    trip = {"window": w["size"], "burn": w["burn"],
+                            "miss_rate": round(rate, 6),
+                            "threshold": round(threshold, 6),
+                            "budget": self.budget, "op": op}
+                    self.trips.append(trip)
+                    fired.append(trip)
+                    self._on_trip(trip)
+            else:
+                w["armed"] = True
+        return fired
+
+    def _on_trip(self, trip: dict) -> None:
+        tel.counter("serve_slo_burn_trips", window=str(trip["window"]))
+        tel.event("slo_burn", **trip)
+        if self.flight_dump:
+            from ..telemetry import recorder
+            recorder.trip(
+                "slo_burn",
+                f"deadline-miss burn: {trip['miss_rate']:.4f} over "
+                f"last {trip['window']} >= {trip['threshold']:.4f} "
+                f"({trip['burn']}x budget {trip['budget']})",
+                **trip)
 
 
 @dataclass(frozen=True)
@@ -62,8 +144,14 @@ class SloPolicy:
 class SlaRecorder:
     """Accumulates served results into the per-op-class SLO ledger."""
 
-    def __init__(self, policy: Optional[SloPolicy] = None) -> None:
+    def __init__(self, policy: Optional[SloPolicy] = None,
+                 monitor: Optional[BurnRateMonitor] = None) -> None:
         self.policy = policy if policy is not None else SloPolicy()
+        # the burn-rate monitor rides every recorder by default: SLO
+        # breaches must page (and flight-dump) in production, not only
+        # when someone remembered to wire a monitor
+        self.monitor = monitor if monitor is not None \
+            else BurnRateMonitor()
         self._hist: Dict[str, LatencyHistogram] = {}
         self._wait: Dict[str, LatencyHistogram] = {}
         self.count: Dict[str, int] = {}
@@ -73,6 +161,7 @@ class SlaRecorder:
 
     def record(self, result: EcResult) -> None:
         op = result.request.op
+        self.monitor.record(op, result.deadline_met)
         h = self._hist.get(op)
         if h is None:
             h = self._hist[op] = LatencyHistogram()

@@ -39,7 +39,26 @@ from .metrics import (
     set_enabled,
     set_global_metrics,
 )
-from .schema import SCHEMA_VERSION, validate_dump
+from .profiler import (
+    ProgramProfiler,
+    global_profiler,
+    profile_entrypoints,
+    profiler_selftest,
+    set_global_profiler,
+)
+from .recorder import (
+    FlightRecorder,
+    flight_recorder_selftest,
+    global_flight_recorder,
+    install_flight_recorder,
+    set_global_flight_recorder,
+)
+from .schema import (
+    SCHEMA_VERSION,
+    validate_dump,
+    validate_flight_dump,
+    validate_profile_section,
+)
 from .spans import (
     Span,
     SpanTracer,
@@ -49,17 +68,25 @@ from .spans import (
 )
 
 
-def dump_all() -> dict:
+def dump_all(profile: bool = False, flight: bool = False) -> dict:
     """The unified observability dump: the legacy perf-counter
     registry (utils/perf.py, the reference's `perf dump` shape), the
     telemetry metrics registry, and the finished span trees — one
-    JSON object, validated by schema.validate_dump."""
+    JSON object, validated by schema.validate_dump.
+
+    ``profile`` adds the device-plane profiler's attribution section
+    (whatever programs the process has captured so far); ``flight``
+    adds the flight recorder's ring + post-mortem dumps."""
     from ..utils.perf import global_perf
 
     out: dict = {"schema_version": SCHEMA_VERSION}
     out.update(global_perf().dump())
     out.update(global_metrics().dump())
     out["spans"] = global_tracer().to_dict()
+    if profile:
+        out["profile"] = global_profiler().to_dict()
+    if flight:
+        out["flight_recorder"] = global_flight_recorder().to_dict()
     return out
 
 
@@ -71,6 +98,8 @@ def reset_all() -> None:
     global_perf().reset()
     global_metrics().reset()
     global_tracer().reset()
+    global_profiler().reset()
+    global_flight_recorder().reset()
 
 
 def telemetry_selftest() -> dict:
@@ -121,8 +150,10 @@ def telemetry_selftest() -> dict:
 
 
 __all__ = [
+    "FlightRecorder",
     "LatencyHistogram",
     "MetricsRegistry",
+    "ProgramProfiler",
     "SCHEMA_VERSION",
     "Span",
     "SpanTracer",
@@ -131,17 +162,27 @@ __all__ = [
     "counter",
     "dump_all",
     "event",
+    "flight_recorder_selftest",
     "gauge",
+    "global_flight_recorder",
     "global_metrics",
+    "global_profiler",
     "global_tracer",
     "install_compile_monitor",
+    "install_flight_recorder",
     "observe",
+    "profile_entrypoints",
+    "profiler_selftest",
     "record_dispatch",
     "reset_all",
     "set_enabled",
+    "set_global_flight_recorder",
     "set_global_metrics",
+    "set_global_profiler",
     "set_global_tracer",
     "span",
     "telemetry_selftest",
     "validate_dump",
+    "validate_flight_dump",
+    "validate_profile_section",
 ]

@@ -165,7 +165,13 @@ class MetricsRegistry:
         """Prometheus text exposition: counters as ``*_total``,
         gauges bare, histograms as quantile summaries with
         ``_sum``/``_count``.  Names are sanitized (`.` → `_`) and
-        prefixed with the registry name."""
+        prefixed with the registry name.
+
+        Exposition-format hardening (ISSUE 10 satellite): every metric
+        family leads with ``# HELP`` + ``# TYPE`` lines, and label
+        VALUES escape backslash, double-quote and newline per the
+        text-format spec — a plugin profile or error string carried as
+        a label can no longer corrupt the scrape."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
@@ -176,32 +182,39 @@ class MetricsRegistry:
             return (self.name + "_" + name).replace(".", "_").replace(
                 "-", "_")
 
+        def _esc(value: str) -> str:
+            # escaping order matters: backslash first, or the escapes
+            # themselves get re-escaped
+            return (value.replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
         def _lbl(labels: LabelKey, extra: str = "") -> str:
-            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            inner = ",".join(f'{k}="{_esc(v)}"' for k, v in labels)
             if extra:
                 inner = f"{inner},{extra}" if inner else extra
             return f"{{{inner}}}" if inner else ""
 
+        def _head(seen: set, n: str, kind: str, src: str) -> None:
+            if n not in seen:
+                seen.add(n)
+                lines.append(f"# HELP {n} ceph_tpu telemetry "
+                             f"{kind} {_esc(src)}")
+                lines.append(f"# TYPE {n} {kind}")
+
         seen_c = set()
         for (name, labels), v in sorted(counters.items()):
             n = _san(name) + "_total"
-            if n not in seen_c:
-                seen_c.add(n)
-                lines.append(f"# TYPE {n} counter")
+            _head(seen_c, n, "counter", name)
             lines.append(f"{n}{_lbl(labels)} {v}")
         seen_g = set()
         for (name, labels), v in sorted(gauges.items()):
             n = _san(name)
-            if n not in seen_g:
-                seen_g.add(n)
-                lines.append(f"# TYPE {n} gauge")
+            _head(seen_g, n, "gauge", name)
             lines.append(f"{n}{_lbl(labels)} {v}")
         seen_h = set()
         for (name, labels), h in sorted(hists.items()):
             n = _san(name)
-            if n not in seen_h:
-                seen_h.add(n)
-                lines.append(f"# TYPE {n} summary")
+            _head(seen_h, n, "summary", name)
             pcts = h.percentiles()
             for q, p in (("0.5", "p50"), ("0.99", "p99"),
                          ("0.999", "p999")):
@@ -281,6 +294,11 @@ def observe(name: str, value: float, **labels) -> None:
 def event(kind: str, **fields) -> None:
     if _enabled:
         global_metrics().event(kind, **fields)
+        # every structured event is also a flight-recorder breadcrumb
+        # (the recorder's ring is the "what happened right before"
+        # record a post-mortem dump freezes)
+        from .recorder import global_flight_recorder
+        global_flight_recorder().note(kind, **fields)
 
 
 @contextlib.contextmanager

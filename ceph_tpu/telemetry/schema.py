@@ -13,11 +13,25 @@ from __future__ import annotations
 
 from typing import List
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 10): optional `profile` (device-plane cost/roofline
+# attribution rows, telemetry/profiler.py) and `flight_recorder`
+# (post-mortem ring + dumps, telemetry/recorder.py) sections join the
+# dump; both validated below when present.
+SCHEMA_VERSION = 2
 
 _HIST_REQUIRED = ("count", "sum", "min", "max", "p50", "p99", "p999",
                   "buckets")
 _SPAN_REQUIRED = ("name", "start", "end", "duration")
+# every attribution row must carry the full join: identity, cost
+# model, measured latency and the roofline verdict (values may be
+# null — a never-dispatched program has no p50 — but the KEYS may not
+# silently vanish)
+_PROFILE_ROW_REQUIRED = ("name", "series", "source", "flops",
+                         "bytes_accessed", "arg_bytes", "calls",
+                         "p50_ms", "achieved_gbps", "utilization_pct")
+_FLIGHT_REQUIRED = ("flight_schema_version", "trigger", "reason",
+                    "time", "entries", "spans", "metrics",
+                    "metrics_delta")
 
 
 def _is_num(v) -> bool:
@@ -71,6 +85,79 @@ def _check_span(path: str, sp, errors: List[str]) -> None:
         _check_span(f"{path}.children[{i}]", child, errors)
 
 
+def validate_profile_section(path: str, section,
+                             errors: List[str] = None) -> List[str]:
+    """Validate the device-plane profiler section (profiler.to_dict
+    shape: program count + attribution rows + hot list)."""
+    errors = [] if errors is None else errors
+    if not isinstance(section, dict):
+        errors.append(f"{path}: profile section must be an object")
+        return errors
+    if not isinstance(section.get("programs"), int):
+        errors.append(f"{path}.programs must be an int")
+    rows = section.get("rows")
+    if not isinstance(rows, list):
+        errors.append(f"{path}.rows must be a list")
+        return errors
+    if len(rows) != section.get("programs"):
+        errors.append(f"{path}.programs != len(rows)")
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            errors.append(f"{path}.rows[{i}] must be an object")
+            continue
+        for k in _PROFILE_ROW_REQUIRED:
+            if k not in row:
+                errors.append(f"{path}.rows[{i}] missing {k!r}")
+        if not isinstance(row.get("name"), str):
+            errors.append(f"{path}.rows[{i}].name must be a string")
+    if not isinstance(section.get("top", []), list):
+        errors.append(f"{path}.top must be a list")
+    return errors
+
+
+def validate_flight_dump(blob) -> List[str]:
+    """Validate one flight-recorder post-mortem blob."""
+    errors: List[str] = []
+    if not isinstance(blob, dict):
+        return ["flight dump must be a JSON object"]
+    for k in _FLIGHT_REQUIRED:
+        if k not in blob:
+            errors.append(f"flight dump missing {k!r}")
+    if blob.get("flight_schema_version") != 1:
+        errors.append("flight_schema_version must be 1")
+    entries = blob.get("entries")
+    if not isinstance(entries, list) or any(
+            not isinstance(e, dict) or "seq" not in e or "kind" not in e
+            or "t" not in e for e in entries):
+        errors.append("entries must be objects with seq+kind+t")
+    elif [e["seq"] for e in entries] != sorted(
+            e["seq"] for e in entries):
+        errors.append("entries must be seq-ordered")
+    spans = blob.get("spans")
+    if not isinstance(spans, dict) or "spans" not in spans:
+        errors.append("flight dump spans must be {spans: [...]}")
+    else:
+        for i, sp in enumerate(spans["spans"]):
+            _check_span(f"flight.spans[{i}]", sp, errors)
+    if not isinstance(blob.get("metrics"), dict):
+        errors.append("flight dump metrics must be an object")
+    if not isinstance(blob.get("metrics_delta"), dict):
+        errors.append("flight dump metrics_delta must be an object")
+    return errors
+
+
+def _check_flight_section(path: str, section,
+                          errors: List[str]) -> None:
+    if not isinstance(section, dict) or "dumps" not in section \
+            or "entries" not in section:
+        errors.append(f"{path}: flight_recorder section must be "
+                      f"{{entries: [...], dumps: [...]}}")
+        return
+    for i, blob in enumerate(section["dumps"]):
+        for e in validate_flight_dump(blob):
+            errors.append(f"{path}.dumps[{i}]: {e}")
+
+
 def validate_dump(dump: dict) -> List[str]:
     """Validate the unified ``dump_all()`` shape; returns a list of
     error strings (empty = valid)."""
@@ -87,8 +174,14 @@ def validate_dump(dump: dict) -> List[str]:
     else:
         for i, sp in enumerate(spans["spans"]):
             _check_span(f"spans[{i}]", sp, errors)
+    if "profile" in dump:
+        validate_profile_section("profile", dump["profile"], errors)
+    if "flight_recorder" in dump:
+        _check_flight_section("flight_recorder",
+                              dump["flight_recorder"], errors)
     registries = [k for k in dump
-                  if k not in ("schema_version", "spans")]
+                  if k not in ("schema_version", "spans", "profile",
+                               "flight_recorder")]
     if not registries:
         errors.append("dump carries no metric registries")
     for reg in registries:
@@ -108,4 +201,5 @@ def validate_dump(dump: dict) -> List[str]:
     return errors
 
 
-__all__ = ["SCHEMA_VERSION", "validate_dump"]
+__all__ = ["SCHEMA_VERSION", "validate_dump", "validate_flight_dump",
+           "validate_profile_section"]

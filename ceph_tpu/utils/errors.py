@@ -106,3 +106,13 @@ class UnrecoverableError(ScrubError):
         super().__init__(detail, shards)
         if cause is not None:
             self.__cause__ = cause
+        # Post-mortem flight dump (docs/OBSERVABILITY.md): every raise
+        # site constructs this class, so construction is the one choke
+        # point where the flight recorder freezes "what the process
+        # was doing right before data became unreadable".  Guarded —
+        # observability must never mask the failure it records.
+        try:
+            from ..telemetry.recorder import record_unrecoverable
+            record_unrecoverable(self)
+        except Exception:  # noqa: BLE001
+            pass

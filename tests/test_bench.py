@@ -261,15 +261,22 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    assert bench.METRIC_VERSION == 6
+    assert bench.METRIC_VERSION == 7
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
                         lambda host_only=False, requests=None: {})
     monkeypatch.setattr(bench, "_cluster_rows",
                         lambda host_only=False: {})
+    monkeypatch.setattr(bench, "_profile_rows",
+                        lambda host_only=False: {})
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["metric_version"] == bench.METRIC_VERSION
+    # metric_version 7: every line carries the device-plane profiler
+    # rows (cost/roofline attribution; docs/OBSERVABILITY.md) — the
+    # error path rides the host analytic model
+    assert "profile_rows" in err
+    assert dict(bench.PROFILE_ROWS)  # at least one declared row
     # metric_version 3: every emitted line carries the telemetry blob
     assert isinstance(err["telemetry"], dict)
     # metric_version 4: every emitted line carries the serving rows
@@ -316,6 +323,10 @@ def test_bench_metadata_records_audit_coverage(monkeypatch):
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
                         lambda host_only=False, requests=None: {})
+    monkeypatch.setattr(bench, "_cluster_rows",
+                        lambda host_only=False: {})
+    monkeypatch.setattr(bench, "_profile_rows",
+                        lambda host_only=False: {})
     meta = bench._audit_meta()
     assert meta["audited_entrypoints"] >= 12
     assert meta["audit_rules"] == sorted([
@@ -338,6 +349,10 @@ def test_bench_last_good_roundtrip(tmp_path, monkeypatch):
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
                         lambda host_only=False, requests=None: {})
+    monkeypatch.setattr(bench, "_cluster_rows",
+                        lambda host_only=False: {})
+    monkeypatch.setattr(bench, "_profile_rows",
+                        lambda host_only=False: {})
     assert bench._read_last_good() is None
     line = {"metric": "encode_gbps_jerasure_rs_k8_m3_1MiB_stripes",
             "value": 116.7, "unit": "GB/s", "layout": "packed"}
@@ -427,3 +442,56 @@ def test_serving_workload_host():
     # host executor never dispatches jax, so no compile accounting
     assert res["stream_compiles"] is None
     assert set(res["op_classes"]) <= {"encode", "decode", "repair"}
+
+
+def test_profile_workload_device():
+    """--workload profile (metric_version 7): the device-plane
+    profiler drives the engine's cached programs and emits per-program
+    attribution rows joining XLA cost_analysis with measured dispatch
+    latency — bytes, FLOPs, p50, achieved GB/s and roofline
+    utilization per (plugin, pattern, engine tier, device count)."""
+    from ceph_tpu.telemetry import ProgramProfiler, set_global_profiler
+    prev = set_global_profiler(ProgramProfiler())
+    try:
+        res = run_bench(["--workload", "profile", "--plugin", "jerasure",
+                         "--parameter", "technique=reed_sol_van",
+                         "--parameter", "k=4", "--parameter", "m=2",
+                         "--size", "8192", "--batch", "4",
+                         "--iterations", "2", "-e", "1"])
+    finally:
+        set_global_profiler(prev)
+    assert res["workload"] == "profile"
+    # serve-encode + serve-decode + fused-repair, one row each
+    assert res["programs"] == 3
+    kinds = sorted(r["kind"] for r in res["profile_rows"])
+    assert kinds == ["fused-repair", "serve-decode", "serve-encode"]
+    for row in res["profile_rows"]:
+        assert row["source"] == "xla"
+        assert row["flops"] > 0 and row["bytes_accessed"] > 0
+        assert row["arg_bytes"] > 0
+        assert row["calls"] >= 3          # warm + 2 timed iterations
+        assert row["p50_ms"] > 0
+        assert row["achieved_gbps"] > 0 and row["hbm_gbps"] > 0
+        assert row["utilization_pct"] is not None
+        assert row["pattern"].startswith("e")
+    assert res["gbps"] > 0 and res["lat_samples"] == 6
+
+
+def test_profile_workload_host_analytic():
+    """--workload profile --device host (the tunnel-down error path):
+    no jax anywhere — the cost side comes from the analytic GF(2^8)
+    matrix model with honest source="analytic" provenance, the
+    measured side from the numpy batch surfaces."""
+    res = run_bench(["--workload", "profile", "--plugin", "jerasure",
+                     "--parameter", "technique=reed_sol_van",
+                     "--parameter", "k=4", "--parameter", "m=2",
+                     "--size", "8192", "--batch", "2",
+                     "--iterations", "2", "-e", "1",
+                     "--device", "host"])
+    assert res["programs"] == 2           # host encode + host decode
+    for row in res["profile_rows"]:
+        assert row["source"] == "analytic"
+        assert row["engine"] == "host"
+        assert row["flops"] > 0 and row["bytes_accessed"] > 0
+        assert row["p50_ms"] > 0 and row["achieved_gbps"] > 0
+    assert res["gbps"] > 0

@@ -257,16 +257,22 @@ def test_set_enabled_master_switch():
 
 def _fresh_world(clk):
     """Swap every process-global observability surface (and the
-    pattern cache + fallback policy, which would otherwise carry warm
-    state between runs) for a deterministic scenario run."""
+    pattern cache + fallback policy + program profiler + flight
+    recorder, which would otherwise carry warm state between runs)
+    for a deterministic scenario run."""
     from ceph_tpu.codes.engine import (PatternCache,
                                        set_global_pattern_cache)
     from ceph_tpu.ops.fallback import FallbackPolicy, set_global_policy
+    from ceph_tpu.telemetry import (FlightRecorder, ProgramProfiler,
+                                    set_global_flight_recorder,
+                                    set_global_profiler)
     state = (telemetry.set_global_tracer(SpanTracer(clock=clk,
                                                     annotate=False)),
              telemetry.set_global_metrics(MetricsRegistry(clock=clk)),
              set_global_pattern_cache(PatternCache()),
-             set_global_policy(FallbackPolicy()))
+             set_global_policy(FallbackPolicy()),
+             set_global_profiler(ProgramProfiler(clock=clk)),
+             set_global_flight_recorder(FlightRecorder(clock=clk)))
     global_perf().reset()
     return state
 
@@ -274,11 +280,15 @@ def _fresh_world(clk):
 def _restore_world(state):
     from ceph_tpu.codes.engine import set_global_pattern_cache
     from ceph_tpu.ops.fallback import set_global_policy
-    tr, reg, cache, policy = state
+    from ceph_tpu.telemetry import (set_global_flight_recorder,
+                                    set_global_profiler)
+    tr, reg, cache, policy, prof, rec = state
     telemetry.set_global_tracer(tr)
     telemetry.set_global_metrics(reg)
     set_global_pattern_cache(cache)
     set_global_policy(policy)
+    set_global_profiler(prof)
+    set_global_flight_recorder(rec)
 
 
 def _repair_scenario(seed=7, objects=5):
@@ -565,3 +575,97 @@ def test_bench_rows_report_latency_percentiles():
     assert 0 < res["lat_p50_ms"] <= res["lat_p99_ms"] \
         <= res["lat_p999_ms"]
     assert res["gbps"] > 0
+
+
+# ----------------------------------------------------------------------
+# Prometheus exposition hardening (ISSUE 10 satellite)
+
+def test_prometheus_help_and_type_lines():
+    reg = MetricsRegistry(clock=FakeClock())
+    reg.counter("pattern_cache_hits")
+    reg.gauge("profiler_programs", 3)
+    reg.observe("dispatch_seconds", 0.004, engine="xla")
+    text = reg.to_prometheus()
+    lines = text.splitlines()
+    # every family leads with HELP then TYPE, in that order, once
+    assert "# HELP ceph_tpu_telemetry_pattern_cache_hits_total " \
+        "ceph_tpu telemetry counter pattern_cache_hits" in lines
+    assert "# HELP ceph_tpu_telemetry_profiler_programs " \
+        "ceph_tpu telemetry gauge profiler_programs" in lines
+    assert "# HELP ceph_tpu_telemetry_dispatch_seconds " \
+        "ceph_tpu telemetry summary dispatch_seconds" in lines
+    helps = [l for l in lines if l.startswith("# HELP ")]
+    types = [l for l in lines if l.startswith("# TYPE ")]
+    assert len(helps) == len(types) == 3
+    for h, t in zip(helps, types):
+        assert h.split()[2] == t.split()[2]       # same family name
+        assert lines.index(h) == lines.index(t) - 1
+
+
+def test_prometheus_label_value_escaping():
+    reg = MetricsRegistry(clock=FakeClock())
+    reg.counter("fallback_tier_transitions",
+                error='RuntimeError: "tunnel\\wedged"\nretrying')
+    text = reg.to_prometheus()
+    (sample,) = [l for l in text.splitlines()
+                 if not l.startswith("#")]
+    # escaped per the exposition format: \\ then \" then \n
+    assert ('error="RuntimeError: \\"tunnel\\\\wedged\\"\\nretrying"'
+            in sample)
+    assert "\n" not in sample                      # one physical line
+
+
+def test_prometheus_plain_values_unescaped():
+    reg = MetricsRegistry(clock=FakeClock())
+    reg.counter("calls", engine="xla")
+    text = reg.to_prometheus()
+    assert 'ceph_tpu_telemetry_calls_total{engine="xla"} 1' in text
+
+
+# ----------------------------------------------------------------------
+# LatencyHistogram.merge ≡ re-record (ISSUE 10 satellite)
+
+@pytest.mark.parametrize("sizes", [(1, 1), (2, 2), (1, 999),
+                                   (500, 499), (37, 0)])
+def test_histogram_merge_equals_rerecord(sizes):
+    """merge() must be exactly re-recording the union stream — same
+    buckets, same count/sum/min/max, same quantiles INCLUDING p999 on
+    tiny counts (rank math: at n < 1000 p999 is the max)."""
+    na, nb = sizes
+    rng = np.random.default_rng(na * 1000 + nb)
+    a_vals = rng.gamma(2.0, 0.003, na).tolist()
+    b_vals = rng.gamma(2.0, 0.010, nb).tolist()
+    a, b, ref = (LatencyHistogram(), LatencyHistogram(),
+                 LatencyHistogram())
+    for v in a_vals:
+        a.record(v)
+        ref.record(v)
+    for v in b_vals:
+        b.record(v)
+        ref.record(v)
+    a.merge(b)
+    da, dref = a.to_dict(), ref.to_dict()
+    # sum is float-accumulated in a different association order
+    # ((Σa)+(Σb) vs sequential) — equal to ulp, not bitwise
+    assert da.pop("sum") == pytest.approx(dref.pop("sum"), rel=1e-12)
+    assert da == dref
+    for p in (0.0, 0.5, 0.99, 0.999, 1.0):
+        assert a.quantile(p) == ref.quantile(p)
+    if 0 < na + nb < 1000:
+        # p999 on tiny counts is the exact observed max (rank clamps)
+        assert a.quantile(0.999) == max(a_vals + b_vals)
+
+
+def test_histogram_merge_empty_and_zero_dump():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    a.merge(b)                                    # empty+empty
+    d = a.to_dict()
+    assert d == {"count": 0, "sum": 0.0, "min": None, "max": None,
+                 "p50": None, "p99": None, "p999": None, "buckets": {}}
+    b.record(0.0)                                 # zero-only stream
+    b.record(0.0)
+    a.merge(b)
+    d = a.to_dict()
+    assert d["count"] == 2 and d["buckets"] == {"zero": 2}
+    assert d["p50"] == 0.0 and d["p999"] == 0.0
+    assert d["min"] == 0.0 and d["max"] == 0.0

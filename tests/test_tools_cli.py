@@ -454,3 +454,88 @@ def test_serve_demo_recoverable_and_unrecoverable():
     out = json.loads(r.stdout)
     assert out["unrecoverable"] is True
     assert "erasure" in out["error"] or "decodable" in out["error"]
+
+
+def test_perf_dump_flight_recorder_deterministic_and_valid():
+    """tools/perf_dump.py --scenario unrecoverable --flight-recorder
+    (ISSUE 10): the seeded past-budget repair freezes a flight-
+    recorder post-mortem whose dump — ring, spans, metrics snapshot,
+    deltas — is schema-valid (v2) and BYTE-identical across reruns
+    under --fake-clock."""
+    import os
+    script = os.path.join(REPO_ROOT, "tools", "perf_dump.py")
+
+    def dump_run():
+        return subprocess.run(
+            [sys.executable, script, "--scenario", "unrecoverable",
+             "--fake-clock", "--flight-recorder", "--validate"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+
+    r1, r2 = dump_run(), dump_run()
+    assert r1.returncode == 0, r1.stderr
+    assert r1.stdout == r2.stdout          # byte-identical
+    dump = json.loads(r1.stdout)
+    fr = dump["flight_recorder"]
+    assert fr["dump_count"] >= 1
+    blob = fr["dumps"][-1]
+    assert blob["trigger"] == "unrecoverable"
+    assert "failure budget" in blob["reason"]
+    assert blob["metrics_delta"]           # counters moved before death
+
+
+def test_perf_dump_profile_filtered_deterministic():
+    """tools/perf_dump.py --profile (ISSUE 10): attribution rows with
+    cost + measured + roofline fields, deterministic under
+    --fake-clock (the measured side rides a tick clock).  Filtered to
+    the engine/serve entries to keep the test fast — the full
+    every-jit-entry coverage gate runs in tools/test_full.sh."""
+    import os
+    script = os.path.join(REPO_ROOT, "tools", "perf_dump.py")
+
+    def profile_run():
+        return subprocess.run(
+            [sys.executable, script, "--scenario", "none", "--profile",
+             "--profile-filter", "engine.fused_repair_call",
+             "--profile-filter", "serve.dispatch",
+             "--fake-clock", "--validate"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+
+    r1, r2 = profile_run(), profile_run()
+    assert r1.returncode == 0, r1.stderr
+    assert r1.stdout == r2.stdout          # byte-identical rows
+    dump = json.loads(r1.stdout)
+    prof = dump["profile"]
+    assert prof["programs"] >= 2
+    for row in prof["rows"]:
+        if row["kind"] != "entrypoint":
+            continue
+        assert row["flops"] is not None
+        assert row["bytes_accessed"] > 0
+        assert row["p50_ms"] > 0
+        assert row["achieved_gbps"] > 0
+        assert row["utilization_pct"] is not None
+    assert prof["top"]                     # hot list populated
+
+
+def test_bench_diff_cli_red_and_green(tmp_path):
+    """tools/bench_diff.py (ISSUE 10): rc 4 + REGRESSION line on a
+    synthetic 20% headline drop, rc 0 on the repo's real checked-in
+    BENCH_* trajectory (the test_full.sh gate)."""
+    import os
+    script = os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "bench", "rc": 0, "tail": "", "parsed": {
+            "metric": "m", "value": 100.0, "git_sha": "aaa",
+            "timestamp": "2026-01-01T00:00:00+00:00"}}))
+    (tmp_path / "BENCH_LAST_GOOD.json").write_text(json.dumps(
+        {"metric": "m", "value": 80.0, "git_sha": "bbb",
+         "timestamp": "2026-02-01T00:00:00+00:00"}))
+    r = subprocess.run([sys.executable, script, "--repo",
+                        str(tmp_path)],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 4, r.stdout
+    assert "REGRESSION" in r.stderr and "headline" in r.stderr
+
+    r = subprocess.run([sys.executable, script],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,279 @@
+#!/usr/bin/env python3
+"""bench_diff — the perf-regression sentinel over the BENCH_* trajectory.
+
+Five BENCH_r*.json rounds sit in the repo with no automated regression
+detection: the bench trajectory was write-only (ISSUE 10).  This tool
+makes it a gate:
+
+1. **Parse the trajectory** — every ``BENCH_r*.json`` driver record
+   (``{n, cmd, rc, tail, parsed}``) plus ``BENCH_LAST_GOOD.json``,
+   across every metric_version (v1 bare-float rows through v7
+   ``{gbps, lat_*}`` dicts; error lines contribute their embedded
+   ``last_good`` record, deduped by (git_sha, timestamp), so a
+   tunnel-down round never reads as a 100% regression).
+2. **Normalize** to named higher-is-better series: ``headline`` (the
+   carry-chain encode GB/s), ``decode:<row>``, ``degraded:<row>``,
+   ``serving:<row>`` (GB/s-under-SLO), ``multichip:<row>``,
+   ``profile:<row>``.  Ratios/latency rows are deliberately excluded —
+   one sentinel, one direction.
+3. **Diff with per-row noise floors** — the CURRENT record (BENCH_
+   LAST_GOOD.json, or ``--candidate <file>`` for a fresh bench line)
+   regresses a row when it falls below the best prior value by more
+   than the row's noise floor.  Floors are per-category: device-chained
+   rows are stable (15–20%), host/scheduler-timed rows are noisy
+   (40–50%) — see FLOORS; override any category with
+   ``--floor cat=frac``.
+4. **Fail loudly** — rc 4 with one REGRESSION line per failing row;
+   rc 0 when clean (including the "single sample, nothing to diff yet"
+   case, reported as such).  tools/test_full.sh runs this against the
+   checked-in trajectory, so a perf PR (the shec/clay XOR kernels are
+   next) cannot merge a silent throughput cliff.
+
+Exit codes: 0 clean · 2 usage · 3 no usable trajectory · 4 regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# per-category relative noise floors: a row only regresses when it
+# drops below best_prior * (1 - floor).  Device --loop chains repeat
+# within a few percent; host-timed recovery/serving rows swing wildly
+# with scheduler load (the repo's own r02-r04 host numbers vary 2x).
+FLOORS: Dict[str, float] = {
+    "headline": 0.15,
+    "decode": 0.20,
+    "multichip": 0.25,
+    "degraded": 0.45,
+    "serving": 0.45,
+    "cluster": 0.50,
+    "profile": 0.60,
+}
+
+
+def _gbps(value) -> Optional[float]:
+    """A row value across metric_versions: v1/v2 bare floats, v3+
+    {gbps, lat_*} dicts; None/garbage -> None."""
+    if isinstance(value, bool):
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    if isinstance(value, dict):
+        g = value.get("gbps")
+        if isinstance(g, (int, float)) and not isinstance(g, bool):
+            return float(g)
+    return None
+
+
+def extract_series(rec: dict) -> Dict[str, float]:
+    """Normalize one bench record into named higher-is-better series."""
+    series: Dict[str, float] = {}
+    v = rec.get("value")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        series["headline"] = float(v)
+    for section, cat in (("decode_rows", "decode"),
+                         ("degraded_rows", "degraded"),
+                         ("multichip_rows", "multichip"),
+                         ("profile_rows", "profile")):
+        body = rec.get(section)
+        if not isinstance(body, dict):
+            continue
+        for name, row in sorted(body.items()):
+            g = _gbps(row)
+            if g is not None and g > 0:
+                series[f"{cat}:{name}"] = g
+    body = rec.get("serving_rows")
+    if isinstance(body, dict):
+        for name, row in sorted(body.items()):
+            if not isinstance(row, dict):
+                continue
+            g = row.get("gbps_under_slo")
+            if not (isinstance(g, (int, float))
+                    and not isinstance(g, bool)):
+                g = _gbps(row)
+            if g is not None and g > 0:
+                series[f"serving:{name}"] = float(g)
+    return series
+
+
+def _record_id(rec: dict) -> Tuple:
+    return (rec.get("git_sha"), rec.get("timestamp"),
+            rec.get("value"))
+
+
+def load_trajectory(repo: str) -> List[Tuple[str, dict]]:
+    """(label, record) for every usable measurement in the BENCH_r*
+    trajectory, oldest first, deduped: a direct good round's parsed
+    line, or the last_good record an error line carries."""
+    out: List[Tuple[str, dict]] = []
+    seen: set = set()
+
+    def _add(label: str, rec) -> None:
+        if not isinstance(rec, dict) or rec.get("value") is None:
+            return
+        rid = _record_id(rec)
+        if rid in seen:
+            return
+        seen.add(rid)
+        out.append((label, rec))
+
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        base = os.path.basename(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = d.get("parsed")
+        if not isinstance(parsed, dict):
+            # tolerate a raw bench line checked in directly
+            parsed = d if "metric" in d else None
+        if not isinstance(parsed, dict):
+            continue
+        _add(base, parsed)
+        _add(f"{base}:last_good", parsed.get("last_good"))
+    return out
+
+
+def load_current(repo: str, candidate: Optional[str]
+                 ) -> Tuple[str, Optional[dict]]:
+    if candidate:
+        with open(candidate, encoding="utf-8") as f:
+            rec = json.load(f)
+        if rec.get("value") is None and isinstance(
+                rec.get("last_good"), dict):
+            # an error-line candidate is judged by its embedded
+            # last-good device measurement, same as the trajectory
+            return (f"{os.path.basename(candidate)}:last_good",
+                    rec["last_good"])
+        return os.path.basename(candidate), rec
+    path = os.path.join(repo, "BENCH_LAST_GOOD.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return "BENCH_LAST_GOOD.json", json.load(f)
+    except (OSError, ValueError):
+        return "BENCH_LAST_GOOD.json", None
+
+
+def diff(trajectory: List[Tuple[str, dict]], current_label: str,
+         current: dict, floors: Dict[str, float]) -> dict:
+    """The sentinel verdict: per-row status against the best prior
+    value, with per-category noise floors."""
+    cur_id = _record_id(current)
+    prior: Dict[str, Tuple[float, str]] = {}
+    for label, rec in trajectory:
+        if _record_id(rec) == cur_id:
+            continue  # the current record riding in the trajectory
+        for name, v in extract_series(rec).items():
+            best = prior.get(name)
+            if best is None or v > best[0]:
+                prior[name] = (v, label)
+    cur_series = extract_series(current)
+    rows, regressions, improvements = [], [], []
+    for name in sorted(set(prior) | set(cur_series)):
+        cat = name.split(":", 1)[0]
+        floor = floors.get(cat, 0.25)
+        cur = cur_series.get(name)
+        best = prior.get(name)
+        row = {"row": name, "current": cur,
+               "best_prior": best[0] if best else None,
+               "best_prior_src": best[1] if best else None,
+               "noise_floor": floor, "status": "ok"}
+        if best is None:
+            row["status"] = "new"          # first sample: nothing to diff
+        elif cur is None:
+            # the row vanished from the current record — that is a
+            # regression of the HARNESS (a silently dropped
+            # measurement is how a cliff hides), not of the kernel
+            row["status"] = "missing"
+            regressions.append(row)
+        else:
+            ratio = cur / best[0]
+            row["ratio"] = round(ratio, 4)
+            if ratio < 1.0 - floor:
+                row["status"] = "regression"
+                regressions.append(row)
+            elif ratio > 1.0 + floor:
+                row["status"] = "improvement"
+                improvements.append(row)
+        rows.append(row)
+    return {"current": current_label,
+            "samples": len(trajectory),
+            "rows": rows,
+            "regressions": [r["row"] for r in regressions],
+            "improvements": [r["row"] for r in improvements],
+            "ok": not regressions}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=REPO,
+                    help="directory holding BENCH_r*.json + "
+                         "BENCH_LAST_GOOD.json")
+    ap.add_argument("--candidate", default=None, metavar="FILE",
+                    help="judge this bench JSON line instead of "
+                         "BENCH_LAST_GOOD.json (a fresh run's output)")
+    ap.add_argument("--floor", action="append", default=[],
+                    metavar="CAT=FRAC",
+                    help="override a category noise floor, e.g. "
+                         "headline=0.1 (repeatable)")
+    ap.add_argument("--json", action="store_true", dest="json_out")
+    args = ap.parse_args(argv)
+
+    floors = dict(FLOORS)
+    for spec in args.floor:
+        if "=" not in spec:
+            ap.error(f"--floor {spec!r} must be CAT=FRAC")
+        cat, frac = spec.split("=", 1)
+        try:
+            floors[cat] = float(frac)
+        except ValueError:
+            ap.error(f"--floor {spec!r}: {frac!r} is not a number")
+
+    trajectory = load_trajectory(args.repo)
+    label, current = load_current(args.repo, args.candidate)
+    if current is None or current.get("value") is None:
+        # no current device measurement at all: nothing to judge — an
+        # outage is the error line's job to report, not a regression
+        print("bench_diff: no current device measurement "
+              f"({label}); nothing to diff", file=sys.stderr)
+        return 0 if trajectory else 3
+    if not trajectory:
+        print("bench_diff: no BENCH_r*.json trajectory found",
+              file=sys.stderr)
+        return 3
+
+    report = diff(trajectory, label, current, floors)
+    if args.json_out:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(f"bench_diff: {len(trajectory)} trajectory sample(s), "
+              f"current={report['current']}")
+        for row in report["rows"]:
+            cur = row["current"]
+            best = row["best_prior"]
+            line = (f"  {row['status'].upper():<12} {row['row']}: "
+                    f"{cur if cur is not None else '-'} "
+                    f"vs best {best if best is not None else '-'}"
+                    f" (floor {int(row['noise_floor'] * 100)}%"
+                    + (f", x{row['ratio']}" if "ratio" in row else "")
+                    + (f", from {row['best_prior_src']}"
+                       if row["best_prior_src"] else "") + ")")
+            print(line)
+    if not report["ok"]:
+        print("bench_diff: REGRESSION on "
+              + ", ".join(report["regressions"]), file=sys.stderr)
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

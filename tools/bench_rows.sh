@@ -105,6 +105,12 @@ run_row "row 9: cluster plane — seeded storm -> balance -> rateless recover ov
     -s $((1<<16)) --workload cluster --osds 1000 --cluster-pgs 1024 \
     --storm-events 40 --batch 8 --json
 
+run_row "row 10: device-plane profiler — per-program cost/roofline attribution for the north-star engine programs (ISSUE 10; XLA bytes/FLOPs x measured p50 -> utilization %, metric_version 7)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<18)) --workload profile --batch 16 --iterations 4 \
+    -e 1 --json
+
 run_row "row 5: 1M-PG bulk CRUSH sweep on device" \
     python tools/bulk_crush_row.py
 

@@ -16,8 +16,23 @@ The telemetry gate in tools/test_full.sh runs this three ways:
                                                        # on the host
                                                        # bench row
 
-Exit codes: 0 ok · 1 schema validation failed · 3 overhead above the
-threshold · 2 usage.
+Device-plane profiler extensions (ISSUE 10, schema_version 2):
+
+    perf_dump.py --profile --validate
+        sweep EVERY jit-tier audited entry point through the
+        cost-attribution profiler (telemetry/profiler.py) and emit
+        the `profile` section — one row per program with bytes/FLOPs,
+        measured p50 and roofline utilization; rc 1 if any jit entry
+        fails to produce a row (the acceptance gate).
+    perf_dump.py --scenario unrecoverable --fake-clock \
+                 --flight-recorder --validate
+        run a seeded past-budget repair whose UnrecoverableError
+        construction freezes a flight-recorder post-mortem; the
+        `flight_recorder` section (ring + dumps) is byte-identical
+        across reruns under --fake-clock.
+
+Exit codes: 0 ok · 1 schema validation / profile coverage failed ·
+3 overhead above the threshold · 2 usage.
 """
 
 from __future__ import annotations
@@ -134,6 +149,70 @@ def run_recovery_scenario(seed: int, objects: int, clock=None) -> None:
                          "converge (bug, not a telemetry problem)")
 
 
+def run_unrecoverable_scenario(seed: int, objects: int,
+                               clock=None) -> int:
+    """Seeded past-budget repair: object 0 loses m+1 shards, so
+    repair_batched constructs an UnrecoverableError — whose
+    construction hook freezes the flight-recorder post-mortem this
+    scenario exists to demonstrate.  The healthy objects still heal.
+    Returns the number of flight dumps the run produced."""
+    from ceph_tpu import telemetry
+    from ceph_tpu.chaos import ShardErasure, inject
+    from ceph_tpu.scrub import repair_batched
+    from ceph_tpu.utils.errors import UnrecoverableError
+
+    ec, sinfo, n, shards_list, hinfos = _build_objects(seed, objects)
+    m = n - ec.get_data_chunk_count()
+    stores = []
+    for i, shards in enumerate(shards_list):
+        lost = (list(range(m + 1)) if i == 0 else [i % n])
+        store, _ = inject(shards, [ShardErasure(shards=lost)],
+                          seed=seed + i, chunk_size=sinfo.chunk_size)
+        stores.append(store)
+    try:
+        repair_batched(sinfo, ec, stores, hinfos, clock=clock)
+    except UnrecoverableError:
+        pass
+    else:
+        raise SystemExit("perf_dump: past-budget scenario repaired?! "
+                         "(bug, not a telemetry problem)")
+    dumps = telemetry.global_flight_recorder().dump_count
+    if dumps < 1:
+        raise SystemExit("perf_dump: UnrecoverableError produced no "
+                         "flight-recorder dump")
+    return dumps
+
+
+def run_profile_sweep(fake_clock: bool, repeats: int,
+                      filters) -> int:
+    """Sweep the jit-tier audit registry through the profiler
+    (telemetry/profiler.py::profile_entrypoints).  Under --fake-clock
+    the measured side runs on a deterministic tick clock so the rows
+    are byte-identical across runs.  rc 1 when an unfiltered sweep
+    leaves any jit entry without an attribution row."""
+    from ceph_tpu import telemetry
+    from ceph_tpu.telemetry.profiler import _Tick
+
+    prof = telemetry.global_profiler()
+    if fake_clock:
+        prof = telemetry.ProgramProfiler(clock=_Tick())
+        telemetry.set_global_profiler(prof)
+    rows, failed = telemetry.profile_entrypoints(
+        filters=tuple(filters or ()), measure=True, repeats=repeats,
+        profiler=prof)
+    if failed:
+        for f in failed:
+            print(f"profile: {f}", file=sys.stderr)
+        if not filters:
+            print(f"profile: {len(failed)} jit entr(ies) have no "
+                  f"attribution row", file=sys.stderr)
+            return 1
+    if not rows:
+        print("profile: sweep produced no rows", file=sys.stderr)
+        return 1
+    return 0
+
+
 def check_overhead(threshold_pct: float, reps: int = 5) -> dict:
     """Instrumentation overhead on the host-path bench row
     (rs_k8_m3_degraded_e1 shape): run the row ``reps`` times with
@@ -174,10 +253,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="repair",
                     choices=["repair", "recovery-churn", "both",
-                             "none"],
+                             "unrecoverable", "none"],
                     help="seeded workload to run before dumping "
-                         "(none: dump whatever the process already "
-                         "recorded)")
+                         "(unrecoverable: a past-budget repair whose "
+                         "UnrecoverableError freezes a flight-"
+                         "recorder post-mortem; none: dump whatever "
+                         "the process already recorded)")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--objects", type=int, default=6)
     ap.add_argument("--format", default="json",
@@ -194,6 +275,24 @@ def main(argv=None) -> int:
                     metavar="PCT",
                     help="measure instrumentation overhead on the "
                          "host-path bench row; rc 3 if above PCT")
+    ap.add_argument("--profile", action="store_true",
+                    help="sweep every jit-tier audited entry point "
+                         "through the cost-attribution profiler and "
+                         "include the `profile` section (rc 1 if an "
+                         "unfiltered sweep leaves a jit entry "
+                         "row-less)")
+    ap.add_argument("--profile-filter", action="append", default=[],
+                    metavar="SUBSTR",
+                    help="restrict --profile to entries whose name "
+                         "contains SUBSTR (repeatable; disables the "
+                         "coverage gate)")
+    ap.add_argument("--profile-repeats", type=int, default=2,
+                    help="measured dispatches per entry in --profile")
+    ap.add_argument("--flight-recorder", action="store_true",
+                    dest="flight",
+                    help="include the flight recorder's ring + post-"
+                         "mortem dumps as the `flight_recorder` "
+                         "section")
     args = ap.parse_args(argv)
 
     if args.check_overhead is not None:
@@ -209,15 +308,27 @@ def main(argv=None) -> int:
             telemetry.SpanTracer(clock=clock, annotate=False))
         telemetry.set_global_metrics(
             telemetry.MetricsRegistry(clock=clock))
+        telemetry.set_global_flight_recorder(
+            telemetry.FlightRecorder(clock=clock))
     else:
         telemetry.install_compile_monitor()
+    telemetry.install_flight_recorder()
     telemetry.reset_all()
     if args.scenario in ("repair", "both"):
         run_repair_scenario(args.seed, args.objects, clock=clock)
     if args.scenario in ("recovery-churn", "both"):
         run_recovery_scenario(args.seed, args.objects, clock=clock)
+    if args.scenario == "unrecoverable":
+        run_unrecoverable_scenario(args.seed, args.objects,
+                                   clock=clock)
+    if args.profile:
+        rc = run_profile_sweep(args.fake_clock, args.profile_repeats,
+                               args.profile_filter)
+        if rc:
+            return rc
 
-    dump = telemetry.dump_all()
+    dump = telemetry.dump_all(profile=args.profile,
+                              flight=args.flight)
     if args.validate:
         errors = telemetry.validate_dump(dump)
         if errors:

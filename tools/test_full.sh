@@ -44,6 +44,21 @@ python tools/perf_dump.py --scenario both --fake-clock --validate \
     >/dev/null || { echo "perf_dump: telemetry schema gate failed"; exit 1; }
 python tools/perf_dump.py --check-overhead 3 \
     || { echo "perf_dump: instrumentation overhead above 3%"; exit 1; }
+# Device-plane profiler gates (ISSUE 10 / docs/OBSERVABILITY.md
+# "Device-plane profiler"): (a) EVERY jit-tier audited entry point
+# must produce a cost/roofline attribution row (rc 1 inside perf_dump
+# when one goes row-less), under a schema-valid (v2) dump; (b) a
+# seeded past-budget repair must freeze a byte-identical, schema-valid
+# flight-recorder post-mortem; (c) tools/bench_diff.py must pass rc0
+# on the checked-in BENCH_r*.json trajectory — the perf-regression
+# sentinel every subsequent perf PR is judged with.
+python tools/perf_dump.py --scenario none --profile --validate \
+    >/dev/null || { echo "perf_dump: profiler coverage gate failed"; exit 1; }
+python tools/perf_dump.py --scenario unrecoverable --fake-clock \
+    --flight-recorder --validate >/dev/null \
+    || { echo "perf_dump: flight-recorder gate failed"; exit 1; }
+python tools/bench_diff.py \
+    || { echo "bench_diff: perf regression against the BENCH_* trajectory"; exit 1; }
 # Serving gate (ISSUE 7 / docs/SERVING.md): the seeded mixed
 # rs/shec/clay stream with the chaos-degraded repair slice must serve
 # byte-identical under a schema-valid telemetry dump (rc 0), and an
