@@ -91,7 +91,18 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # analytic GF(2^8) cost model (source="analytic" — host-only fields,
 # honest provenance).  tools/bench_diff.py is the regression sentinel
 # over this whole trajectory.
-METRIC_VERSION = 7
+# v8 (ISSUE 11, scenario harness): a `scenario_rows` section — the
+# composed "production day" (--workload scenario; ceph_tpu/scenario/):
+# the canonical mixed client stream serves at SLO while a churn storm
+# remaps the cluster and recovery heals straggler-skewed damage, all
+# admission-gated by the mClock QoS arbiter (scenario/qos.py) closing
+# the loop from the serve burn-rate monitor to the recovery throttle's
+# per-OSD weighted limits.  Rows carry GB/s-under-SLO (the bench_diff
+# `scenario` category series), p99/deadline-miss under contention,
+# recovery/churn counters and the QoS ledger; correctness
+# (byte-verified stream, byte-identical heal, zero data loss) gates
+# in-workload.  Host-only on the tunnel-down error path, same loop.
+METRIC_VERSION = 8
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -230,6 +241,50 @@ PROFILE_ROWS = [
       "--device", "jax", "--batch", "16", "--iterations", "4",
       "-e", "1"]),
 ]
+
+
+# Scenario rows (ISSUE 11): the composed production day — the mixed
+# client stream at SLO + churn storm + straggler recovery under
+# mClock QoS arbitration, one real clock (--workload scenario;
+# ceph_tpu/scenario/, docs/SCENARIOS.md).  Correctness (byte-verified
+# stream, byte-identical heal) gates in-workload; the row's
+# gbps_under_slo is the bench_diff `scenario` series, so
+# p99-under-contention cannot silently regress.
+SCENARIO_ROWS = [
+    ("scenario_mixed_day",
+     ["--workload", "scenario", "--device", "jax",
+      "--size", str(1 << 14), "--requests", "128", "--batch", "4",
+      "-e", "1", "--storm-events", "6", "--seed", "42"]),
+]
+
+SCENARIO_ROW_FIELDS = (
+    "gbps_under_slo", "deadline_miss_rate", "arbiter_enabled",
+    "qos_scale_min", "qos_burn_trips", "slo_burn_trips",
+    "recovery_rounds", "recovery_ops_completed", "churn_events",
+    "straggler_reassignments", "rateless_p99_ratio",
+    "stream_compiles", "requests", "verified")
+
+
+def _scenario_rows(host_only: bool = False,
+                   requests: int | None = None) -> dict:
+    rows = {}
+    for name, argv in SCENARIO_ROWS:
+        row_argv = list(argv)
+        if host_only:
+            row_argv += ["--device", "host"]
+        if requests is not None:
+            row_argv += ["--requests", str(requests)]
+        try:
+            res = _run(row_argv)
+            row = _row_result(res)
+            for f in SCENARIO_ROW_FIELDS:
+                row[f] = res.get(f)
+            rows[name] = row
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"scenario/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
 
 
 def _profile_rows(host_only: bool = False) -> dict:
@@ -472,6 +527,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "serving_rows": _serving_rows(host_only=True, requests=96),
         "cluster_rows": _cluster_rows(host_only=True),
         "profile_rows": _profile_rows(host_only=True),
+        "scenario_rows": _scenario_rows(host_only=True, requests=64),
         "last_good": _read_last_good(),
         "telemetry": _telemetry_blob(),
         **_audit_meta(),
@@ -674,6 +730,7 @@ def main() -> int:
         "multichip_rows": _multichip_rows(),
         "cluster_rows": _cluster_rows(),
         "profile_rows": _profile_rows(),
+        "scenario_rows": _scenario_rows(),
         "lat_p50_ms": best.get("lat_p50_ms"),
         "lat_p99_ms": best.get("lat_p99_ms"),
         "lat_p999_ms": best.get("lat_p999_ms"),

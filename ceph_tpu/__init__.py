@@ -26,6 +26,11 @@ capabilities of the reference (agraf/ceph, a fork of ceph/ceph):
                           (PGScrubber/ECBackend recovery analog) with
                           structured degraded-mode errors
                           (docs/ROBUSTNESS.md).
+- ``ceph_tpu.scenario`` — the "production day" composition layer:
+                          declarative replayable scenarios (serving +
+                          churn + recovery + scrub on one clock) with
+                          mClock-style QoS arbitration between client
+                          SLOs and background work (docs/SCENARIOS.md).
 - ``ceph_tpu.bench``    — CLI harness mirroring
                           src/test/erasure-code/ceph_erasure_code_benchmark.cc
                           and src/tools/crushtool.cc --test.

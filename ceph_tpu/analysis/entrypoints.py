@@ -52,7 +52,7 @@ from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
 # topology-generated map + the rateless over-planned dispatch)
 FAMILIES = ("jerasure", "isa", "shec", "lrc", "clay",
             "engine", "ops", "crush", "scrub", "telemetry", "serve",
-            "cluster")
+            "cluster", "scenario")
 
 # public device surfaces a plugin family can expose; the completeness
 # check requires every one present on a family's representative
@@ -618,6 +618,30 @@ def _build_flight_recorder() -> Built:
     return Built(flight_recorder_selftest, (), flight_recorder_selftest)
 
 
+def _build_scenario_runner() -> Built:
+    """The composed production-day scenario as a host-tier entry
+    (ISSUE 11): cluster build, store staging, client stream, churn,
+    recovery rounds and scrub ticks under the mClock arbiter, end to
+    end on a FakeClock — ZERO jax compiles, zero device arrays,
+    forever.  The composition layer is host scheduling by
+    construction; its only device seams are the already-audited
+    serve.dispatch / engine.fused_repair_call programs."""
+    from ..scenario.runner import scenario_selftest
+
+    return Built(scenario_selftest, (), scenario_selftest)
+
+
+def _build_scenario_qos() -> Built:
+    """The mClock arbiter as a host-tier entry (ISSUE 11):
+    reservation floor, weight pacing, limit ceiling and burn-rate
+    scaling exercised on a FakeClock — ZERO compiles, zero device
+    arrays.  QoS arbitration that touched the device would contend
+    with exactly the work it schedules."""
+    from ..scenario.qos import qos_selftest
+
+    return Built(qos_selftest, (), qos_selftest)
+
+
 # ----------------------------------------------------------------------
 # THE registry
 
@@ -718,6 +742,14 @@ def registry() -> Tuple[EntryPoint, ...]:
         EntryPoint("cluster.rateless_dispatch", "cluster", "jit",
                    _build_cluster_rateless_dispatch,
                    allow=GF_XLA_PRIMS, trace_budget=16),
+        # the scenario composition layer (ISSUE 11): the runner and
+        # the QoS arbiter are host scheduling forever — 0 compiles,
+        # 0 device arrays (their device seams are the audited serve/
+        # engine programs above)
+        EntryPoint("scenario.runner", "scenario", "host",
+                   _build_scenario_runner, allow=None, trace_budget=0),
+        EntryPoint("scenario.qos", "scenario", "host",
+                   _build_scenario_qos, allow=None, trace_budget=0),
     ]
     return tuple(entries)
 

@@ -133,7 +133,7 @@ class ErasureCodeBench:
                         choices=["encode", "decode", "degraded",
                                  "repair-batched", "recovery-churn",
                                  "serving", "multichip", "cluster",
-                                 "profile"])
+                                 "profile", "scenario"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -179,8 +179,13 @@ class ErasureCodeBench:
                              "planning, the straggler-exposed "
                              "control)")
         ap.add_argument("--slow-factor", type=float, default=10.0,
-                        help="cluster workload: the injected "
-                             "straggler's slowdown on shard 0")
+                        help="cluster/scenario workloads: the "
+                             "injected straggler's slowdown on "
+                             "shard 0")
+        ap.add_argument("--no-arbiter", action="store_true",
+                        help="scenario workload: disable the mClock "
+                             "QoS arbiter (the contention control "
+                             "run)")
         ap.add_argument("-E", "--erasures-generation", default="random",
                         choices=["random", "exhaustive"], dest="erasures_generation")
         ap.add_argument("--erased", action="append", type=int, default=None,
@@ -1036,7 +1041,7 @@ class ErasureCodeBench:
         the p99 ratio IS the straggler-tolerance claim.  --device
         host runs the identical loop over the host mapper at a
         downscaled size (the tunnel-down error path)."""
-        from ..chaos import ShardErasure, Straggler, inject
+        from ..chaos import ShardErasure, Straggler
         from ..cluster import (ClusterSpec, balance_cluster,
                                build_cluster, rateless_recover,
                                run_churn_storm,
@@ -1044,9 +1049,9 @@ class ErasureCodeBench:
         from ..cluster.rateless import plan_assignments, \
             simulate_first_k
         from ..cluster.topology import EC_POOL
-        from ..codes.stripe import HashInfo, StripeInfo
-        from ..codes.stripe import encode as stripe_encode
+        from ..codes.stripe import StripeInfo
         from ..recovery import healed
+        from ..scenario.runner import stage_damaged_objects
         a = self.args
         host = a.device == "host"
         # the host engine walks the python mapper per pg per epoch —
@@ -1068,23 +1073,15 @@ class ErasureCodeBench:
         chunk_size = ec.get_chunk_size(a.size)
         width = k * chunk_size
         sinfo = StripeInfo(k, width)
-        rng = np.random.default_rng(a.seed)
         n_objects = max(4, a.batch)
-        objects, stores, hinfos = [], [], []
-        for i in range(n_objects):
-            obj = rng.integers(0, 256, size=width,
-                               dtype=np.uint8).tobytes()
-            shards = stripe_encode(sinfo, ec, obj)
-            hinfo = HashInfo(n)
-            hinfo.append(0, shards)
-            # one shared erasure pattern (shard 1): one pattern batch,
-            # one fused dispatch — and the control sim below can
-            # reconstruct the unit work exactly
-            st, _ = inject(shards, [ShardErasure(shards=[1])],
-                           seed=a.seed + i, chunk_size=chunk_size)
-            objects.append(shards)
-            stores.append(st)
-            hinfos.append(hinfo)
+        # one shared erasure pattern (shard 1): one pattern batch, one
+        # fused dispatch — and the control sim below can reconstruct
+        # the unit work exactly.  Staging rides the shared scenario
+        # runner (scenario/runner.py), same bytes as the old inline
+        # loop.
+        objects, stores, hinfos, _ = stage_damaged_objects(
+            sinfo, ec, n_objects, seed=a.seed,
+            injectors_for=lambda i: [ShardErasure(shards=[1])])
 
         from ..chaos import MapChurn
         churn = MapChurn(seed=a.seed + 1, max_down=8, fire_every=1,
@@ -1149,6 +1146,59 @@ class ErasureCodeBench:
                             if base_p99 > 0 else None)
         res["straggler_reassignments"] = \
             rr.schedule.straggler_reassignments if rr.schedule else 0
+        res["verified"] = True
+        return res
+
+    # -- scenario (the composed production day: client traffic at SLO
+    # + churn storm + straggler recovery under mClock QoS arbitration
+    # — ISSUE 11, ceph_tpu/scenario/, docs/SCENARIOS.md) ----------------
+
+    def scenario_workload(self) -> dict:
+        """Production-day contention numbers (metric_version 8): the
+        canonical mixed rs/shec/clay client stream serves at SLO while
+        a churn storm remaps the cluster, recovery rounds heal
+        straggler-skewed damage and scrub verifies — all on ONE real
+        clock, admission-gated by the mClock arbiter
+        (scenario/qos.py; --no-arbiter is the unthrottled control).
+        The contention axes — GB/s-under-SLO, p99,
+        deadline-miss-rate — are what tools/bench_diff.py's
+        ``scenario`` category gates.  Correctness gates run
+        in-workload: client stream byte-verified against ground
+        truth, recovery converged with byte-identical heal, zero data
+        loss."""
+        from ..scenario import default_scenario, run_scenario
+        a = self.args
+        executor = "device" if a.device == "jax" else "host"
+        spec = default_scenario(
+            seed=a.seed, n_requests=a.requests, stripe_size=a.size,
+            damaged_objects=max(2, a.batch), erasures=a.erasures,
+            storm_events=min(a.storm_events, 12),
+            straggler_factor=a.slow_factor)
+        run = run_scenario(spec, executor=executor,
+                           enable_arbiter=not a.no_arbiter)
+        rep = run.report
+        if not rep.ok():
+            raise RuntimeError(f"scenario gates failed: {rep.gates}")
+        res = self._result("scenario", rep.slo["elapsed_s"],
+                           rep.slo["bytes"])
+        res["lat_p50_ms"] = rep.slo["p50_ms"]
+        res["lat_p99_ms"] = rep.slo["p99_ms"]
+        res["lat_p999_ms"] = rep.slo["p999_ms"]
+        res["lat_samples"] = rep.slo["requests"]
+        res["gbps_under_slo"] = rep.gbps_under_slo
+        res["deadline_miss_rate"] = rep.deadline_miss_rate
+        res["arbiter_enabled"] = rep.arbiter_enabled
+        res["qos_scale_min"] = rep.qos["scale_min"]
+        res["qos_burn_trips"] = rep.qos["burn_trips"]
+        res["slo_burn_trips"] = rep.slo_burn_trips
+        res["recovery_rounds"] = rep.recovery_rounds
+        res["recovery_ops_completed"] = \
+            rep.recovery["ops_completed"]
+        res["churn_events"] = rep.churn["events"]
+        res["straggler_reassignments"] = \
+            rep.rateless["straggler_reassignments"]
+        res["rateless_p99_ratio"] = rep.rateless["p99_ratio"]
+        res["stream_compiles"] = rep.slo.get("stream_compiles")
         res["verified"] = True
         return res
 
@@ -1270,6 +1320,8 @@ class ErasureCodeBench:
             return self.cluster()
         if self.args.workload == "profile":
             return self.profile_workload()
+        if self.args.workload == "scenario":
+            return self.scenario_workload()
         return self.decode()
 
 

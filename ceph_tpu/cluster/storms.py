@@ -33,16 +33,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..chaos.adversaries import MapChurn
-from ..crush.incremental import (
-    CEPH_OSD_UP,
-    Incremental,
-    apply_incremental,
-    catch_up,
-    get_epoch,
-)
-from ..crush.osdmap import IN_WEIGHT, OSDMap
-from ..telemetry import metrics as tel
-from ..telemetry.spans import global_tracer
+from ..crush.incremental import catch_up, get_epoch
+from ..crush.osdmap import OSDMap
 
 
 @dataclass
@@ -142,68 +134,20 @@ def run_churn_storm(m: OSDMap, *, seed: int = 0, events: int = 100,
     bulk evaluator; then (``drain``) revive every still-downed osd,
     one epoch each, until the cluster is whole again.
 
+    Thin wrapper over the scenario runner's storm loop
+    (scenario/runner.py::drive_storm — THE driver; composed
+    scenarios step the same churn machinery turn-by-turn under QoS
+    arbitration instead of in one burst).
+
     ``measure_every``: diff the cluster every Nth epoch (>1 trades
     per-epoch resolution for wall time on very large sweeps; the
     remap count then covers the whole stride)."""
-    pids = sorted(m.pools) if pool_ids is None else sorted(pool_ids)
-    if churn is None:
-        churn = MapChurn(seed=seed, max_down=max_down, fire_every=1,
-                         max_events=events, avoid_osds=avoid_osds)
-    rep = StormReport(seed=seed, engine=engine, pool_ids=list(pids))
-    rep.total_pgs = sum(m.pools[pid].pg_num for pid in pids)
-    rep.epoch_start = get_epoch(m)
-    tracer = global_tracer()
-    measure_every = max(1, measure_every)
+    from ..scenario.runner import drive_storm
 
-    prev = _snapshot(m, pids, engine)
-    pending = 0
-
-    def measure(force: bool = False) -> None:
-        nonlocal prev, pending
-        pending += 1
-        if pending < measure_every and not force:
-            rep.remapped_per_epoch.append(0)
-            return
-        cur = _snapshot(m, pids, engine)
-        n = _diff_count(prev, cur)
-        rep.remapped_per_epoch.append(n)
-        rep.total_remapped += n
-        rep.peak_remapped = max(rep.peak_remapped, n)
-        tel.counter("cluster_storm_remapped_pgs", n)
-        prev = cur
-        pending = 0
-
-    with tracer.span("cluster.storm", events=events, engine=engine):
-        for _ in range(events):
-            inc = churn.step(m, stage="storm")
-            if inc is None:
-                continue
-            rep.events += 1
-            kind = churn.events[-1]["kind"]
-            rep.event_kinds[kind] = rep.event_kinds.get(kind, 0) + 1
-            measure()
-        if drain:
-            with tracer.span("cluster.storm.drain",
-                             downed=len(churn.downed)):
-                while churn.downed:
-                    osd = churn.downed.pop(0)
-                    inc = Incremental(
-                        epoch=get_epoch(m) + 1,
-                        new_state={osd: CEPH_OSD_UP},
-                        new_weight={osd: IN_WEIGHT})
-                    apply_incremental(m, inc)
-                    churn.incrementals.append(inc)
-                    churn.events.append({"kind": "drain_revive",
-                                         "stage": "drain",
-                                         "epoch": inc.epoch,
-                                         "detail": f"osd.{osd}"})
-                    rep.drain_events += 1
-                    measure(force=not churn.downed)
-    rep.epoch_end = get_epoch(m)
-    tel.counter("cluster_storm_epochs", rep.epochs)
-    tel.gauge("cluster_remap_fraction", rep.mean_remap_fraction,
-              phase="storm")
-    return rep
+    return drive_storm(m, seed=seed, events=events, max_down=max_down,
+                       pool_ids=pool_ids, engine=engine, drain=drain,
+                       avoid_osds=avoid_osds, churn=churn,
+                       measure_every=measure_every)
 
 
 def verify_storm_equivalence(m: OSDMap, churn: MapChurn,

@@ -215,6 +215,9 @@ class RecoveryOrchestrator:
         # end-to-end recovery latency histogram measures from here to
         # journal clear, throttle/fence deferral rounds included)
         self._obj_first_planned: Dict[int, float] = {}
+        # journal replay runs once per daemon lifetime, on the first
+        # round (run() or an incremental run_round() caller alike)
+        self._replayed = False
 
     # -- adversary hooks -------------------------------------------------
 
@@ -408,37 +411,64 @@ class RecoveryOrchestrator:
 
     # -- the driver ------------------------------------------------------
 
-    def run(self) -> RecoveryReport:
-        """One daemon lifetime: journal replay, then recovery rounds
-        until converged (nothing actionable left) or max_rounds."""
+    def run_round(self) -> int:
+        """One recovery round, callable incrementally: journal replay
+        on the first call (the daemon's crash-recovery step), then one
+        plan → decode → write-back pass.  Returns the number of ops
+        the plan produced — 0 means nothing actionable remained and
+        the report is marked ``converged``; a non-zero return with
+        ``rounds`` already at ``max_rounds`` means the budget is
+        spent (the round was NOT executed).
+
+        ``run()`` loops this to convergence; a composed scenario
+        (scenario/runner.py) calls it one round at a time under QoS
+        arbitration, interleaved with client traffic on the same
+        clock."""
         r = self.report
         tracer = global_tracer()
-        with tracer.span("recovery.run", objects=len(self.stores)):
+        if not self._replayed:
             r.epoch_start = get_epoch(self.osdmap)
             with tracer.span("journal_replay"):
                 stats = self.journal.replay(self.stores)
             r.journal_replays += 1
             tel.counter("recovery_journal_replays")
             r.journal.merge(stats)
+            self._replayed = True
+        self._churn("plan")
+        with tracer.span("plan"):
+            ops = self._plan()
+        self._crash("plan.after_scrub")
+        r.epoch_end = get_epoch(self.osdmap)
+        if not ops:
+            r.converged = True
+            return 0
+        if r.rounds >= self.max_rounds:
+            return len(ops)
+        r.rounds += 1
+        with tracer.span("round", round=r.rounds):
+            with tracer.span("decode", ops=len(ops)):
+                payloads = self._decode(ops)
+            self.throttle.reset_round()
+            with tracer.span("writeback", ops=len(ops)):
+                self._writeback(ops, payloads)
+        r.epoch_end = get_epoch(self.osdmap)
+        if self.round_delay:
+            self.clock.sleep(self.round_delay)
+        return len(ops)
+
+    def run(self) -> RecoveryReport:
+        """One daemon lifetime: journal replay, then recovery rounds
+        until converged (nothing actionable left) or max_rounds."""
+        r = self.report
+        tracer = global_tracer()
+        with tracer.span("recovery.run", objects=len(self.stores)):
             while True:
-                self._churn("plan")
-                with tracer.span("plan"):
-                    ops = self._plan()
-                self._crash("plan.after_scrub")
-                if not ops:
-                    r.converged = True
-                    break
-                if r.rounds >= self.max_rounds:
-                    break
-                r.rounds += 1
-                with tracer.span("round", round=r.rounds):
-                    with tracer.span("decode", ops=len(ops)):
-                        payloads = self._decode(ops)
-                    self.throttle.reset_round()
-                    with tracer.span("writeback", ops=len(ops)):
-                        self._writeback(ops, payloads)
-                if self.round_delay:
-                    self.clock.sleep(self.round_delay)
+                before = r.rounds
+                n = self.run_round()
+                if n == 0:
+                    break               # converged
+                if r.rounds == before:
+                    break               # budget spent, round not run
             r.epoch_end = get_epoch(self.osdmap)
         return r
 

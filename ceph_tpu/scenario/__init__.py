@@ -1,0 +1,57 @@
+"""ceph_tpu.scenario — the "production day" composition layer
+(ISSUE 11 / ROADMAP open item 3, docs/SCENARIOS.md).
+
+Every plane below this package ships its own excellent driver; this
+package is the first thing that runs them *against each other* the
+way production would — client traffic at SLO while a churn storm
+forces remaps and rateless recovery heals stragglers:
+
+- ``spec``   — :class:`ScenarioSpec`: ClusterSpec + TrafficSpec + a
+               timed chaos schedule + QoS tags, JSON round-trippable,
+               seeded so a FakeClock run replays byte-identically.
+- ``qos``    — :class:`MClockArbiter`: mClock-style
+               reservation/weight/limit arbitration between the
+               client class and recovery/scrub/rebalance, scaled
+               live by the client deadline-miss burn rate (the loop
+               from serve/sla.py's monitor to recovery/throttle.py's
+               per-OSD weighted limits, finally closed).
+- ``runner`` — the single event loop: the serving loop (moved here
+               from serve/loadgen.py), the storm loop (moved from
+               cluster/storms.py), shared store staging, and
+               :func:`run_scenario` composing all of it on one
+               injectable clock.
+- ``report`` — :class:`ScenarioReport`: one deterministic JSON
+               artifact joining the SLO scorecard, recovery/churn
+               counters, the rateless schedule, and the QoS ledger.
+
+tools/scenario_demo.py drives it end to end from one seed;
+``bench.py --workload scenario`` is the round-artifact row, gated by
+tools/bench_diff.py's ``scenario`` category.
+"""
+
+from .qos import MClockArbiter, qos_selftest  # noqa: F401
+from .report import ScenarioReport  # noqa: F401
+from .runner import (  # noqa: F401
+    ScenarioRun,
+    drain_churn,
+    drive_storm,
+    run_scenario,
+    run_serving_scenario,
+    scenario_selftest,
+    stage_damaged_objects,
+)
+from .spec import (  # noqa: F401
+    QOS_CLASSES,
+    ChaosSchedule,
+    QosSpec,
+    ScenarioSpec,
+    default_scenario,
+)
+
+__all__ = [
+    "ChaosSchedule", "MClockArbiter", "QOS_CLASSES", "QosSpec",
+    "ScenarioReport", "ScenarioRun", "ScenarioSpec", "default_scenario",
+    "drain_churn", "drive_storm", "qos_selftest", "run_scenario",
+    "run_serving_scenario", "scenario_selftest",
+    "stage_damaged_objects",
+]

@@ -1,0 +1,93 @@
+"""ScenarioReport — one deterministic JSON artifact per scenario run.
+
+Joins every plane's accounting for one composed run: the serving SLO
+scorecard (serve/sla.py report), the recovery orchestrator's full
+counter set (replans/fences/journal — the correctness ledger), the
+rateless straggler schedule (p99 vs the no-straggler baseline — the
+arXiv 1804.10331 claim), the churn summary, the QoS arbiter snapshot
+(grants/denials/scale — the contention ledger), and optionally the
+device-plane profiler's attribution rows (bench's ``scenario_rows``
+join them in).
+
+``to_json()`` is the replay witness: sorted keys, every derived float
+rounded at the source, no wall-clock or process-global state — two
+FakeClock runs of one seed serialize byte-identically
+(tests/test_scenario.py pins it; tools/scenario_demo.py gates on it).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class ScenarioReport:
+    """The whole production day, one JSON-stable value."""
+
+    name: str = "scenario"
+    seed: int = 0
+    executor: str = "host"
+    arbiter_enabled: bool = True
+    elapsed_s: float = 0.0
+    turns: int = 0
+    recovery_rounds: int = 0
+    scrub_ticks: int = 0
+    slo: dict = field(default_factory=dict)
+    recovery: dict = field(default_factory=dict)
+    rateless: dict = field(default_factory=dict)
+    churn: dict = field(default_factory=dict)
+    qos: dict = field(default_factory=dict)
+    slo_burn_trips: int = 0
+    gates: dict = field(default_factory=dict)
+    profile: Optional[List[dict]] = None
+
+    # -- convenience accessors (the contention axes) ---------------------
+
+    @property
+    def p99_ms(self) -> Optional[float]:
+        return self.slo.get("p99_ms")
+
+    @property
+    def deadline_miss_rate(self) -> Optional[float]:
+        return self.slo.get("deadline_miss_rate")
+
+    @property
+    def gbps_under_slo(self) -> Optional[float]:
+        return self.slo.get("gbps_under_slo")
+
+    def ok(self) -> bool:
+        """Every correctness gate held (the SLO axes are measurements,
+        not gates — a missed deadline is a result, lost data is not)."""
+        g = self.gates
+        return bool(g.get("converged") and g.get("healed")
+                    and g.get("verified_requests"))
+
+    def to_dict(self) -> dict:
+        out = {
+            "name": self.name,
+            "seed": self.seed,
+            "executor": self.executor,
+            "arbiter_enabled": self.arbiter_enabled,
+            "elapsed_s": self.elapsed_s,
+            "turns": self.turns,
+            "recovery_rounds": self.recovery_rounds,
+            "scrub_ticks": self.scrub_ticks,
+            "slo": self.slo,
+            "recovery": self.recovery,
+            "rateless": self.rateless,
+            "churn": self.churn,
+            "qos": self.qos,
+            "slo_burn_trips": self.slo_burn_trips,
+            "gates": self.gates,
+        }
+        if self.profile is not None:
+            out["profile"] = self.profile
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+
+__all__ = ["ScenarioReport"]

@@ -1,0 +1,274 @@
+"""Declarative "production day" scenarios — one spec, one replayable run.
+
+A :class:`ScenarioSpec` names everything a production-shape day needs
+in one JSON-round-trippable value:
+
+- a **cluster** (:class:`~ceph_tpu.cluster.topology.ClusterSpec` —
+  the seeded synthetic topology the recovery pg places into),
+- client **traffic** (:class:`~ceph_tpu.serve.loadgen.TrafficSpec` —
+  the seeded request stream with per-op deadlines, i.e. the SLO),
+- a timed **chaos schedule** (:class:`ChaosSchedule` — churn-storm
+  budget and cadence, the straggler, and the shard damage that seeds
+  recovery work),
+- **QoS tags** (:class:`QosSpec` — per-class mClock
+  reservation/weight/limit vectors plus the burn-rate feedback knobs
+  the arbiter closes the SLO loop with, scenario/qos.py).
+
+``run_scenario`` (scenario/runner.py) stands the whole thing up from
+the spec and interleaves it on ONE injectable clock, so a FakeClock
+run replays byte-identically from ``seed`` — the same contract every
+chaos artifact in this repo carries, now for the full composed system.
+
+Everything here is a pure value: building a spec never imports jax,
+never builds a cluster, never touches a clock.  ``to_json``/
+``from_json`` round-trip exactly (pinned in tests/test_scenario.py),
+so a scenario JSON checked into a bug report IS the reproducer.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field, replace
+from typing import Dict, Optional
+
+from ..cluster.topology import ClusterSpec
+from ..serve.loadgen import CodecSpec, TrafficSpec
+
+# the background work classes the QoS arbiter schedules against the
+# foreground ``client`` class (scenario/qos.py)
+QOS_CLASSES = ("client", "recovery", "scrub", "rebalance")
+
+
+@dataclass(frozen=True)
+class ChaosSchedule:
+    """The timed adversary half of a scenario (all seeds derive from
+    the ScenarioSpec seed, so the schedule alone carries no RNG
+    state)."""
+
+    # churn storm: MapChurn event budget, fired every Nth runner turn
+    # once the scenario clock passes ``storm_at_s``; leftover downed
+    # osds are drained (revived) after the stream ends, exactly like
+    # cluster/storms.py, so recovery can always converge
+    storm_events: int = 6
+    storm_at_s: float = 0.0
+    storm_every_turns: int = 8
+    max_down: int = 2
+    # rateless-recovery straggler (chaos.Straggler): one mesh shard
+    # ``straggler_factor`` x slower
+    straggler_shard: int = 0
+    straggler_factor: float = 10.0
+    # the damage that seeds recovery work: shards erased/corrupted per
+    # damaged object
+    damaged_objects: int = 4
+    erasures: int = 1
+    corruptions: int = 0
+    # background scrub verify ticks over the healthy objects, one per
+    # admitted turn, up to this budget
+    scrub_ticks: int = 8
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosSchedule":
+        return cls(**d)
+
+
+@dataclass(frozen=True)
+class QosSpec:
+    """mClock-style per-class tags + the SLO feedback knobs.
+
+    ``reservation``/``limit`` are ops/s (0 = none); ``weight`` is the
+    proportional share, granted at ``weight_rate`` ops/s per weight
+    unit while the client SLO is healthy.  ``miss_budget`` is the
+    tolerated client deadline-miss rate over a rolling ``window``;
+    as the measured rate climbs toward ``burn`` x budget the arbiter
+    scales background weight/limit down to ``floor`` (reservations
+    are never scaled — a background class is throttled, not starved).
+    """
+
+    enabled: bool = True
+    reservation: Dict[str, float] = field(default_factory=lambda: {
+        "recovery": 4.0, "scrub": 1.0, "rebalance": 2.0})
+    weight: Dict[str, float] = field(default_factory=lambda: {
+        "client": 8.0, "recovery": 4.0, "scrub": 1.0, "rebalance": 2.0})
+    limit: Dict[str, float] = field(default_factory=lambda: {
+        "recovery": 200.0, "scrub": 50.0, "rebalance": 100.0})
+    weight_rate: float = 40.0
+    miss_budget: float = 0.02
+    burn: float = 4.0
+    window: int = 32
+    floor: float = 0.05
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QosSpec":
+        return cls(enabled=d["enabled"],
+                   reservation=dict(d["reservation"]),
+                   weight=dict(d["weight"]),
+                   limit=dict(d["limit"]),
+                   weight_rate=d["weight_rate"],
+                   miss_budget=d["miss_budget"], burn=d["burn"],
+                   window=d["window"], floor=d["floor"])
+
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    """One declarative, seeded, byte-identically replayable scenario."""
+
+    name: str = "production-day"
+    seed: int = 42
+    cluster: ClusterSpec = field(default_factory=ClusterSpec)
+    traffic: TrafficSpec = None  # required; validated below
+    chaos: ChaosSchedule = field(default_factory=ChaosSchedule)
+    qos: QosSpec = field(default_factory=QosSpec)
+    # the codec recovery heals with (None = traffic.codecs[0]); its
+    # chunk count must match the cluster's EC pool width so every
+    # erased shard has a placement slot
+    recovery_codec: Optional[CodecSpec] = None
+    recovery_stripe: int = 1 << 12
+    recovery_ps: int = 5
+    # sim-mode service models (FakeClock runs): modeled device
+    # bandwidth for serving dispatches and per-recovery-round /
+    # per-scrub-tick / per-churn-step costs in seconds — the shared
+    # "device seconds" foreground and background contend for
+    service_gbps: float = 10.0
+    recovery_round_s: float = 0.008
+    scrub_tick_s: float = 0.002
+    churn_step_s: float = 0.004
+    max_recovery_rounds: int = 200
+
+    def __post_init__(self) -> None:
+        if self.traffic is None:
+            raise ValueError("ScenarioSpec needs a TrafficSpec")
+        ec = self.codec_for_recovery()
+        n = self._codec_width(ec)
+        pool_n = self.cluster.ec_k + self.cluster.ec_m
+        if not self.cluster.ec_pg_num:
+            raise ValueError("scenario cluster needs an EC pool "
+                             "(ec_pg_num > 0) for the recovery pg")
+        if pool_n < n:
+            raise ValueError(
+                f"recovery codec {ec.name} needs {n} placement slots "
+                f"but the cluster EC pool is size {pool_n}")
+
+    def codec_for_recovery(self) -> CodecSpec:
+        return self.recovery_codec or self.traffic.codecs[0]
+
+    @staticmethod
+    def _codec_width(codec: CodecSpec) -> int:
+        # k+m from the profile without instantiating the plugin (the
+        # spec is a pure value; lrc's l adds locals, counted via k+m
+        # only for the slot check, which the runner re-validates live)
+        p = codec.profile
+        return int(p.get("k", 0)) + int(p.get("m", 0))
+
+    # -- JSON round trip -------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "cluster": asdict(self.cluster),
+            "traffic": self.traffic.to_dict(),
+            "chaos": self.chaos.to_dict(),
+            "qos": self.qos.to_dict(),
+            "recovery_codec": (self.recovery_codec.to_dict()
+                               if self.recovery_codec else None),
+            "recovery_stripe": self.recovery_stripe,
+            "recovery_ps": self.recovery_ps,
+            "service_gbps": self.service_gbps,
+            "recovery_round_s": self.recovery_round_s,
+            "scrub_tick_s": self.scrub_tick_s,
+            "churn_step_s": self.churn_step_s,
+            "max_recovery_rounds": self.max_recovery_rounds,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScenarioSpec":
+        cl = dict(d["cluster"])
+        cl["weight_tiers"] = tuple(cl["weight_tiers"])
+        cl["device_classes"] = tuple(cl["device_classes"])
+        rc = d.get("recovery_codec")
+        return cls(
+            name=d["name"], seed=d["seed"],
+            cluster=ClusterSpec(**cl),
+            traffic=TrafficSpec.from_dict(d["traffic"]),
+            chaos=ChaosSchedule.from_dict(d["chaos"]),
+            qos=QosSpec.from_dict(d["qos"]),
+            recovery_codec=CodecSpec.from_dict(rc) if rc else None,
+            recovery_stripe=d["recovery_stripe"],
+            recovery_ps=d["recovery_ps"],
+            service_gbps=d["service_gbps"],
+            recovery_round_s=d["recovery_round_s"],
+            scrub_tick_s=d["scrub_tick_s"],
+            churn_step_s=d["churn_step_s"],
+            max_recovery_rounds=d["max_recovery_rounds"],
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "ScenarioSpec":
+        return cls.from_dict(json.loads(s))
+
+    def with_qos(self, **kw) -> "ScenarioSpec":
+        """A copy with QoS knobs replaced (``with_qos(enabled=False)``
+        is the arbiter-off control every contention claim compares
+        against)."""
+        return replace(self, qos=replace(self.qos, **kw))
+
+
+def default_scenario(seed: int = 42, n_requests: int = 128,
+                     stripe_size: int = 1 << 14,
+                     damaged_objects: int = 4, erasures: int = 1,
+                     storm_events: int = 6,
+                     straggler_factor: float = 10.0,
+                     **overrides) -> ScenarioSpec:
+    """The canonical contention day: the mixed rs/shec/clay client
+    stream at TIGHT deadlines while a churn storm forces remaps and
+    rateless recovery heals straggler-skewed damage — the pinned tier-1
+    scenario, the demo default, and the bench ``--workload scenario``
+    row all run this shape.
+
+    Deadlines are deliberately tight against the sim service model
+    (``service_gbps``/``recovery_round_s``): contention must COST
+    something, or arbiter-on vs arbiter-off proves nothing.
+    """
+    codecs = [
+        CodecSpec("rs_k4_m2", "jerasure",
+                  {"technique": "reed_sol_van", "k": "4", "m": "2"},
+                  stripe_size, weight=3.0),
+        CodecSpec("shec_k4_m3_c2", "shec",
+                  {"k": "4", "m": "3", "c": "2"}, stripe_size,
+                  weight=2.0),
+        CodecSpec("clay_k4_m2_d5", "clay",
+                  {"k": "4", "m": "2", "d": "5"}, stripe_size,
+                  weight=1.0),
+    ]
+    # client decode/repair requests always carry a single erasure (a
+    # decodable pattern for every codec in the mix); ``erasures`` is
+    # the CHAOS knob — how many shards each damaged object loses,
+    # i.e. the recovery difficulty (past the code's budget ⇒ the
+    # structured-unrecoverable rc-2 path)
+    traffic = TrafficSpec(
+        seed=seed, n_requests=n_requests, codecs=codecs,
+        arrival="closed", erasures=1, concurrency=16,
+        ladder=(1, 2, 4, 8),
+        deadlines={"encode": 0.006, "decode": 0.006, "repair": 0.015})
+    cluster = ClusterSpec(seed=seed, racks=4, hosts_per_rack=3,
+                          osds_per_host=2, replicated_pg_num=32,
+                          ec_pg_num=16, ec_k=4, ec_m=2)
+    chaos = ChaosSchedule(storm_events=storm_events,
+                          damaged_objects=damaged_objects,
+                          erasures=erasures,
+                          straggler_factor=straggler_factor)
+    return ScenarioSpec(seed=seed, cluster=cluster, traffic=traffic,
+                        chaos=chaos, **overrides)
+
+
+__all__ = ["QOS_CLASSES", "ChaosSchedule", "QosSpec", "ScenarioSpec",
+           "default_scenario"]

@@ -36,11 +36,6 @@ import numpy as np
 
 from .batcher import LADDER, ContinuousBatcher
 from .queue import AdmissionQueue, EcRequest, EcResult
-from .sla import SlaRecorder, SloPolicy
-
-# advance floor when the sim clock would otherwise stall (a due event
-# exactly at `now` always makes progress on the next poll)
-_TICK = 1e-4
 
 
 @dataclass(frozen=True)
@@ -52,6 +47,17 @@ class CodecSpec:
     profile: Dict[str, str]
     stripe_size: int
     weight: float = 1.0
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "plugin": self.plugin,
+                "profile": dict(self.profile),
+                "stripe_size": self.stripe_size, "weight": self.weight}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CodecSpec":
+        return cls(name=d["name"], plugin=d["plugin"],
+                   profile=dict(d["profile"]),
+                   stripe_size=d["stripe_size"], weight=d["weight"])
 
 
 @dataclass
@@ -81,6 +87,30 @@ class TrafficSpec:
                              f"closed|open")
         if not self.codecs:
             raise ValueError("spec needs at least one CodecSpec")
+
+    def to_dict(self) -> dict:
+        """JSON-ready spec (ScenarioSpec round-trips through this)."""
+        return {
+            "seed": self.seed, "n_requests": self.n_requests,
+            "codecs": [c.to_dict() for c in self.codecs],
+            "op_mix": dict(self.op_mix),
+            "deadlines": dict(self.deadlines),
+            "arrival": self.arrival, "rate": self.rate,
+            "concurrency": self.concurrency, "erasures": self.erasures,
+            "ladder": list(self.ladder),
+            "queue_capacity": self.queue_capacity, "pool": self.pool,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrafficSpec":
+        return cls(
+            seed=d["seed"], n_requests=d["n_requests"],
+            codecs=[CodecSpec.from_dict(c) for c in d["codecs"]],
+            op_mix=dict(d["op_mix"]), deadlines=dict(d["deadlines"]),
+            arrival=d["arrival"], rate=d["rate"],
+            concurrency=d["concurrency"], erasures=d["erasures"],
+            ladder=tuple(d["ladder"]),
+            queue_capacity=d["queue_capacity"], pool=d["pool"])
 
 
 def default_spec(seed: int = 42, n_requests: int = 256,
@@ -271,12 +301,6 @@ class ServingRun:
     stream_compiles: Optional[int] = None
 
 
-def _device_compiles() -> int:
-    from ..telemetry import global_metrics
-
-    return global_metrics().counter_value("jax_backend_compiles")
-
-
 def run_serving_scenario(spec: TrafficSpec, clock=None,
                          executor: str = "device",
                          service_model=None,
@@ -285,6 +309,12 @@ def run_serving_scenario(spec: TrafficSpec, clock=None,
                          offsets: Optional[List[float]] = None
                          ) -> ServingRun:
     """Drive ``spec``'s stream through queue → batcher → SLO ledger.
+
+    Thin wrapper over the scenario runner's serving event loop
+    (scenario/runner.py — THE driver, where composed scenarios
+    interleave background work on the same clock; with no background
+    hooks, as here, the loop is byte-for-byte the standalone serving
+    scenario this function has always run).
 
     ``executor="device"`` additionally wires the persistent
     compilation cache (utils/compile_cache.py, when the env knob is
@@ -297,95 +327,8 @@ def run_serving_scenario(spec: TrafficSpec, clock=None,
     degrades its repair payloads through the chaos injectors first
     and then serves those exact objects.
     """
-    from ..utils.retry import SystemClock
+    from ..scenario.runner import run_serving_scenario as _drive
 
-    if clock is None:
-        clock = SystemClock()
-    if requests is not None:
-        reqs = requests
-        if spec.arrival == "open" and offsets is None:
-            raise ValueError("open-loop arrival needs offsets for a "
-                             "pre-built request list")
-    else:
-        gen = LoadGenerator(spec)
-        reqs, offsets = gen.generate()
-    slo = SloPolicy(deadlines=dict(spec.deadlines))
-    queue = AdmissionQueue(clock=clock, capacity=spec.queue_capacity,
-                           slo=slo)
-    batcher = ContinuousBatcher(clock=clock, ladder=spec.ladder,
-                                executor=executor,
-                                service_model=service_model)
-    sla = SlaRecorder(slo)
-    monitor = False
-    if executor == "device":
-        from ..telemetry import install_compile_monitor
-        from ..utils.compile_cache import maybe_initialize_compile_cache
-
-        maybe_initialize_compile_cache()
-        monitor = install_compile_monitor()
-    if warmup:
-        batcher.warmup(reqs)
-    compiles_before = _device_compiles() if monitor else None
-
-    results: List[EcResult] = []
-    start = clock.monotonic()
-
-    def _absorb(batch: List[EcResult]) -> None:
-        for res in batch:
-            sla.record(res)
-        results.extend(batch)
-
-    if spec.arrival == "open":
-        arrivals = [start + off for off in offsets]
-        i = 0
-        while i < len(reqs) or batcher.pending() or len(queue):
-            now = clock.monotonic()
-            while i < len(reqs) and arrivals[i] <= now:
-                queue.submit(reqs[i])
-                i += 1
-            fired = batcher.poll(queue)
-            _absorb(fired)
-            if fired:
-                continue
-            nxt = []
-            if i < len(reqs):
-                nxt.append(arrivals[i])
-            wake = batcher.next_wakeup()
-            if wake is not None:
-                nxt.append(wake)
-            if not nxt:
-                _absorb(batcher.flush())
-                break
-            now = clock.monotonic()
-            clock.sleep(max(min(nxt) - now, _TICK))
-    else:
-        i = 0
-        inflight = 0
-        while i < len(reqs) or batcher.pending() or len(queue):
-            while inflight < spec.concurrency and i < len(reqs):
-                if not queue.submit(reqs[i]):
-                    break
-                i += 1
-                inflight += 1
-            fired = batcher.poll(queue)
-            _absorb(fired)
-            inflight -= len(fired)
-            if fired:
-                continue
-            wake = batcher.next_wakeup()
-            if wake is None:
-                _absorb(batcher.flush())
-                break
-            clock.sleep(max(wake - clock.monotonic(), _TICK))
-    elapsed = clock.monotonic() - start
-    report = sla.report(elapsed, padding=batcher.padding_stats())
-    report["admitted"] = queue.admitted
-    report["rejected"] = queue.rejected
-    report["arrival"] = spec.arrival
-    report["seed"] = spec.seed
-    stream_compiles = None
-    if monitor:
-        stream_compiles = _device_compiles() - compiles_before
-        report["stream_compiles"] = stream_compiles
-    return ServingRun(results=results, report=report, queue=queue,
-                      batcher=batcher, stream_compiles=stream_compiles)
+    return _drive(spec, clock=clock, executor=executor,
+                  service_model=service_model, warmup=warmup,
+                  requests=requests, offsets=offsets)

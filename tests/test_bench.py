@@ -261,7 +261,7 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    assert bench.METRIC_VERSION == 7
+    assert bench.METRIC_VERSION == 8
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
@@ -270,8 +270,15 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
                         lambda host_only=False: {})
     monkeypatch.setattr(bench, "_profile_rows",
                         lambda host_only=False: {})
+    monkeypatch.setattr(bench, "_scenario_rows",
+                        lambda host_only=False, requests=None: {})
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["metric_version"] == bench.METRIC_VERSION
+    # metric_version 8: every line carries the scenario rows (the
+    # composed production day under QoS arbitration — GB/s-under-SLO
+    # and p99 under contention; docs/SCENARIOS.md)
+    assert "scenario_rows" in err
+    assert dict(bench.SCENARIO_ROWS)  # at least one declared row
     # metric_version 7: every line carries the device-plane profiler
     # rows (cost/roofline attribution; docs/OBSERVABILITY.md) — the
     # error path rides the host analytic model

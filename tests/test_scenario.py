@@ -1,0 +1,370 @@
+"""Scenario harness + QoS arbitration tier-1 slice (ceph_tpu/scenario,
+docs/SCENARIOS.md).
+
+The acceptance axes of ISSUE 11:
+
+- ScenarioSpec JSON round trip: the printed spec IS the reproducer.
+- Replay determinism: same seed + FakeClock ⇒ byte-identical
+  ScenarioReport JSON across runs.
+- The pinned contention scenario: client traffic + churn storm +
+  straggler recovery on one seed — with the arbiter enabled, client
+  p99 AND deadline-miss-rate are strictly better than arbiter-off,
+  recovery still converges with byte-identical heal and zero data
+  loss in both runs.
+- Batched ≡ per-request payload byte-identity preserved UNDER
+  contention, across rs/shec/clay.
+- mClock tag semantics: reservation floor, weight pacing, limit
+  ceiling, burn-rate scaling, deterministic hold times.
+- scenario_* / qos_* telemetry with schema-valid dumps; the
+  scenario.runner / scenario.qos host-tier audit entries stay green
+  (0 compiles, 0 device arrays).
+"""
+
+import json
+
+import pytest
+
+from ceph_tpu.scenario import (
+    ChaosSchedule,
+    MClockArbiter,
+    QosSpec,
+    ScenarioSpec,
+    default_scenario,
+    run_scenario,
+)
+from ceph_tpu.serve.loadgen import (
+    CodecSpec,
+    TrafficSpec,
+    throughput_service_model,
+)
+from ceph_tpu.utils.retry import FakeClock
+
+
+def sim_run(spec, enabled=None):
+    return run_scenario(spec, clock=FakeClock(), executor="host",
+                        service_model=throughput_service_model(),
+                        enable_arbiter=enabled)
+
+
+# ----------------------------------------------------------------------
+# spec
+
+def test_spec_json_roundtrip():
+    spec = default_scenario(seed=7, n_requests=32)
+    clone = ScenarioSpec.from_json(spec.to_json())
+    assert clone == spec
+    assert clone.to_json() == spec.to_json()
+    # a tweaked spec round-trips too (frozen sub-specs replaced)
+    tweaked = spec.with_qos(enabled=False, floor=0.2)
+    clone2 = ScenarioSpec.from_json(tweaked.to_json())
+    assert clone2 == tweaked and clone2 != spec
+
+
+def test_spec_validation():
+    traffic = TrafficSpec(codecs=[CodecSpec(
+        "rs_k4_m2", "jerasure",
+        {"technique": "reed_sol_van", "k": "4", "m": "2"}, 4096)])
+    with pytest.raises(ValueError, match="TrafficSpec"):
+        ScenarioSpec(traffic=None)
+    # recovery codec wider than the cluster's EC pool: every erased
+    # shard needs a placement slot
+    from ceph_tpu.cluster.topology import ClusterSpec
+    with pytest.raises(ValueError, match="placement slots"):
+        ScenarioSpec(traffic=traffic,
+                     cluster=ClusterSpec(ec_k=2, ec_m=1))
+    with pytest.raises(ValueError, match="EC pool"):
+        ScenarioSpec(traffic=traffic,
+                     cluster=ClusterSpec(ec_pg_num=0))
+
+
+# ----------------------------------------------------------------------
+# replay determinism
+
+def test_scenario_replay_byte_identical():
+    """Same seed ⇒ the same ScenarioReport JSON, byte for byte — the
+    whole composed run (batch composition, arbitration decisions,
+    recovery rounds, churn epochs) is a pure function of the spec."""
+    spec = default_scenario(seed=42, n_requests=64,
+                            damaged_objects=3, storm_events=4)
+    a = sim_run(spec)
+    b = sim_run(spec)
+    assert a.report.to_json() == b.report.to_json()
+    assert a.serving.batcher.dispatch_log == \
+        b.serving.batcher.dispatch_log
+    # a different seed is a different day (the witness is real)
+    c = sim_run(default_scenario(seed=43, n_requests=64,
+                                 damaged_objects=3, storm_events=4))
+    assert c.report.to_json() != a.report.to_json()
+
+
+# ----------------------------------------------------------------------
+# THE pinned contention scenario (the acceptance gate)
+
+def test_contention_arbiter_strictly_better():
+    """Client traffic + churn storm + straggler recovery on one seed:
+    arbiter-on client p99 and deadline-miss-rate are STRICTLY better
+    than arbiter-off; recovery converges with byte-identical heal and
+    zero data loss in both; the client stream is byte-identical to
+    ground truth in both."""
+    spec = default_scenario(seed=42, n_requests=128)
+    on = sim_run(spec).report
+    off = sim_run(spec, enabled=False).report
+    for rep in (on, off):
+        assert rep.gates["converged"], rep.gates
+        assert rep.gates["healed"], rep.gates
+        assert rep.gates["verified_requests"], rep.gates
+        assert rep.gates["unrecoverable"] == []
+        assert rep.recovery["ops_completed"] >= spec.chaos.damaged_objects
+    assert on.arbiter_enabled and not off.arbiter_enabled
+    # contention happened at all (the control actually hurts)
+    assert off.deadline_miss_rate > 0
+    # ... and the arbiter strictly removes part of that cost
+    assert on.p99_ms < off.p99_ms, (on.p99_ms, off.p99_ms)
+    assert on.deadline_miss_rate < off.deadline_miss_rate
+    assert on.gbps_under_slo > off.gbps_under_slo
+    # the arbiter visibly yielded: scale dropped and background was
+    # denied at least once
+    assert on.qos["scale_min"] < 1.0
+    denials = sum(sum(c["denials"].values())
+                  for c in on.qos["classes"].values())
+    assert denials > 0
+    # arbiter-off never denies
+    assert all(not c["denials"]
+               for c in off.qos["classes"].values())
+
+
+# ----------------------------------------------------------------------
+# batched ≡ per-request under contention, rs/shec/clay
+
+CONTENTION_CODECS = [
+    CodecSpec("rs_k4_m2", "jerasure",
+              {"technique": "reed_sol_van", "k": "4", "m": "2"}, 8192),
+    CodecSpec("shec_k4_m3_c2", "shec",
+              {"k": "4", "m": "3", "c": "2"}, 8192),
+    CodecSpec("clay_k4_m2_d5", "clay",
+              {"k": "4", "m": "2", "d": "5"}, 8192),
+]
+
+
+@pytest.mark.parametrize("codec", CONTENTION_CODECS,
+                         ids=[c.name for c in CONTENTION_CODECS])
+def test_stream_byte_identity_under_contention(codec):
+    """The zero-warm-recompile batching contract survives the
+    composed scenario: with recovery rounds and churn stealing clock
+    between polls, batched (padded, demuxed) client execution remains
+    byte-identical to the generator's per-request ground truth — and
+    recovery heals its own objects byte-identically meanwhile."""
+    from ceph_tpu.cluster.topology import ClusterSpec
+    traffic = TrafficSpec(
+        seed=11, n_requests=48, codecs=[codec], arrival="closed",
+        erasures=1, concurrency=12, ladder=(1, 2, 4, 8),
+        deadlines={"encode": 0.004, "decode": 0.004, "repair": 0.01})
+    spec = ScenarioSpec(
+        seed=11, traffic=traffic,
+        # EC pool wide enough for any of the three recovery codecs
+        cluster=ClusterSpec(seed=11, racks=4, hosts_per_rack=3,
+                            osds_per_host=2, replicated_pg_num=32,
+                            ec_pg_num=16, ec_k=4, ec_m=3),
+        chaos=ChaosSchedule(storm_events=3, damaged_objects=3,
+                            scrub_ticks=4))
+    run = sim_run(spec)
+    rep = run.report
+    assert rep.slo["requests"] == 48
+    assert rep.gates["verified_requests"], rep.gates
+    assert rep.gates["healed"] and rep.gates["converged"]
+    # contention really interleaved: background rounds ran during the
+    # stream (not only in the post-stream drain)
+    assert rep.recovery_rounds >= 1
+    assert rep.scrub_ticks >= 1
+
+
+# ----------------------------------------------------------------------
+# mClock tag semantics
+
+def mk_arbiter(clock, **kw):
+    defaults = dict(reservation={"recovery": 2.0},
+                    weight={"recovery": 4.0},
+                    limit={"recovery": 40.0},
+                    weight_rate=10.0, miss_budget=0.02,
+                    burn=4.0, window=16, floor=0.1)
+    defaults.update(kw)
+    return MClockArbiter(QosSpec(**defaults), clock=clock)
+
+
+def test_qos_limit_is_a_ceiling():
+    """No matter how fast a class asks, grants never exceed the limit
+    rate (tags advance max(tag, now) + 1/rate — the mClock
+    recurrence)."""
+    clock = FakeClock()
+    arb = mk_arbiter(clock, limit={"recovery": 10.0})
+    grants = 0
+    for _ in range(1000):
+        if arb.admit("recovery"):
+            grants += 1
+        clock.sleep(0.001)                 # asks at 1000/s for 1 s
+    assert grants <= 11                    # 10/s ceiling (+ first ask)
+    assert grants >= 9
+
+
+def test_qos_reservation_survives_burn():
+    """Under full SLO burn, weight and limit scale down to the floor
+    but the reservation floor still grants — recovery is throttled,
+    never starved (the mClock point)."""
+    clock = FakeClock()
+    arb = mk_arbiter(clock, reservation={"recovery": 2.0},
+                     limit={"recovery": 1000.0})
+    for _ in range(16):
+        arb.record_client(False)           # every request misses
+    assert arb.pressure() == 1.0
+    assert arb.background_scale() == pytest.approx(0.1)
+    grants = 0
+    for _ in range(2000):
+        if arb.admit("recovery"):
+            grants += 1
+        clock.sleep(0.001)                 # 2 s of asking under burn
+    # ~2/s reservation + ~4/s scaled weight over 2 s, never zero
+    assert 3 <= grants <= 14, grants
+    # the window refills clean: the scale recovers to 1.0
+    for _ in range(16):
+        arb.record_client(True)
+    assert arb.background_scale() == 1.0
+
+
+def test_qos_disabled_always_grants_and_client_never_gated():
+    clock = FakeClock()
+    arb = MClockArbiter(QosSpec(enabled=False), clock=clock)
+    assert all(arb.admit("recovery") for _ in range(50))
+    arb2 = mk_arbiter(clock, limit={"recovery": 1.0})
+    assert all(arb2.admit("client") for _ in range(50))
+    snap = arb2.snapshot()
+    assert snap["classes"]["client"]["grants"] == 50
+    assert snap["classes"]["client"]["denials"] == {}
+    with pytest.raises(ValueError, match="qos class"):
+        arb2.admit("mystery")
+
+
+def test_qos_hold_for_is_the_exact_backoff():
+    """hold_for names the earliest instant admit could grant: denied
+    now, granted after sleeping exactly that long."""
+    clock = FakeClock()
+    arb = mk_arbiter(clock, reservation={"recovery": 0.0},
+                     weight={"recovery": 1.0}, weight_rate=10.0,
+                     limit={"recovery": 10.0})
+    assert arb.admit("recovery")           # consumes the ready tags
+    assert not arb.admit("recovery")
+    hold = arb.hold_for("recovery")
+    assert hold > 0.0
+    clock.sleep(hold)
+    assert arb.admit("recovery")
+    assert arb.hold_for("client") == 0.0
+
+
+# ----------------------------------------------------------------------
+# telemetry + audit
+
+def test_scenario_telemetry_counters_and_schema():
+    """A composed run lands scenario_* and qos_* series in the unified
+    registry and the dump stays schema-valid."""
+    from ceph_tpu import telemetry
+    from ceph_tpu.telemetry.schema import validate_dump
+
+    sim_run(default_scenario(seed=5, n_requests=32,
+                             damaged_objects=2, storm_events=2))
+    reg = telemetry.global_metrics()
+    assert reg.counter_value("scenario_turns") > 0
+    assert reg.counter_value("scenario_recovery_rounds") > 0
+    assert reg.counter_value("scenario_scrub_ticks") > 0
+    dump = telemetry.dump_all()
+    assert validate_dump(dump) == []
+    qos_series = [k for k in dump["ceph_tpu_telemetry"]
+                  if k.startswith("qos_grants")]
+    assert qos_series, "qos_grants series missing from the dump"
+
+
+def test_scenario_entries_registered_and_green():
+    """scenario.runner and scenario.qos are host-tier audited entries:
+    zero compiles, zero device arrays, forever."""
+    from ceph_tpu.analysis.entrypoints import registry
+    from ceph_tpu.analysis.jaxpr_audit import (audit_entry_point,
+                                               run_sentinel)
+    ents = {e.name: e for e in registry()}
+    for name in ("scenario.runner", "scenario.qos"):
+        assert ents[name].kind == "host"
+        e = ents[name]
+        built = e.build()
+        audit = audit_entry_point(e, built)
+        assert audit.findings == [], (name, audit.findings)
+        s = run_sentinel(e, built)
+        assert s.findings == [], (name, s.findings)
+        assert s.warm_compiles == 0
+
+
+# ----------------------------------------------------------------------
+# the orchestrator's incremental rounds (the refactor the runner rides)
+
+def test_run_round_incremental_equals_run():
+    """Round-at-a-time recovery (run_round, what the scenario
+    interleaves) converges to the same heal and the same counters as
+    the one-shot run() loop."""
+    from ceph_tpu.chaos import ShardErasure
+    from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+    from ceph_tpu.codes.stripe import StripeInfo
+    from ceph_tpu.cluster.topology import EC_POOL, ClusterSpec, \
+        build_cluster
+    from ceph_tpu.recovery import IntentJournal, RecoveryOrchestrator, \
+        healed
+    from ceph_tpu.scenario.runner import stage_damaged_objects
+
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    ec.min_xla_bytes = float("inf")
+    sinfo = StripeInfo(4, 4 * ec.get_chunk_size(4096))
+    m = build_cluster(ClusterSpec(seed=3, racks=4, hosts_per_rack=3,
+                                  osds_per_host=2, ec_k=4, ec_m=2,
+                                  replicated_pg_num=16, ec_pg_num=16))
+
+    def one(mode):
+        originals, stores, hinfos, _ = stage_damaged_objects(
+            sinfo, ec, 3, seed=99,
+            injectors_for=lambda i: [ShardErasure(n=1)])
+        orch = RecoveryOrchestrator(
+            sinfo, ec, m, EC_POOL, 5, stores, hinfos,
+            journal=IntentJournal(), device=False)
+        if mode == "run":
+            rep = orch.run()
+        else:
+            while True:
+                n = orch.run_round()
+                if n == 0:
+                    break
+            rep = orch.report
+        assert rep.converged and healed(stores, originals)
+        return rep.to_dict()
+
+    assert one("run") == one("rounds")
+
+
+# ----------------------------------------------------------------------
+# bench workload
+
+def test_bench_scenario_workload_host():
+    """`--workload scenario --device host` runs the composed day on
+    the real clock, gates correctness in-workload, and reports the
+    contention axes bench.py's scenario_rows (metric_version 8)
+    carry."""
+    from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+
+    b = ErasureCodeBench()
+    b.setup(["--workload", "scenario", "--device", "host",
+             "--size", "8192", "--requests", "32", "--batch", "2",
+             "--storm-events", "2", "--seed", "42"])
+    res = b.run()
+    assert res["workload"] == "scenario"
+    assert res["verified"] is True
+    assert res["arbiter_enabled"] is True
+    assert res["gbps"] > 0
+    assert res["gbps_under_slo"] is not None
+    assert 0.0 <= res["deadline_miss_rate"] <= 1.0
+    assert res["recovery_ops_completed"] >= 2
+    assert res["lat_samples"] == 32
+    json.dumps(res)  # the row must be JSON-serializable end to end

@@ -7,15 +7,17 @@ makes it a gate:
 
 1. **Parse the trajectory** — every ``BENCH_r*.json`` driver record
    (``{n, cmd, rc, tail, parsed}``) plus ``BENCH_LAST_GOOD.json``,
-   across every metric_version (v1 bare-float rows through v7
+   across every metric_version (v1 bare-float rows through v8
    ``{gbps, lat_*}`` dicts; error lines contribute their embedded
    ``last_good`` record, deduped by (git_sha, timestamp), so a
    tunnel-down round never reads as a 100% regression).
 2. **Normalize** to named higher-is-better series: ``headline`` (the
    carry-chain encode GB/s), ``decode:<row>``, ``degraded:<row>``,
    ``serving:<row>`` (GB/s-under-SLO), ``multichip:<row>``,
-   ``profile:<row>``.  Ratios/latency rows are deliberately excluded —
-   one sentinel, one direction.
+   ``scenario:<row>`` (GB/s-under-SLO *under contention* — the
+   p99-under-contention gate of ISSUE 11), ``profile:<row>``.
+   Ratios/latency rows are deliberately excluded — one sentinel, one
+   direction.
 3. **Diff with per-row noise floors** — the CURRENT record (BENCH_
    LAST_GOOD.json, or ``--candidate <file>`` for a fresh bench line)
    regresses a row when it falls below the best prior value by more
@@ -54,6 +56,11 @@ FLOORS: Dict[str, float] = {
     "degraded": 0.45,
     "serving": 0.45,
     "cluster": 0.50,
+    # scenario rows measure the client stream UNDER deliberate
+    # background contention on a host-scheduled clock — the noisiest
+    # category by construction, but a silent p99-under-contention
+    # cliff must still trip the sentinel
+    "scenario": 0.55,
     "profile": 0.60,
 }
 
@@ -89,8 +96,13 @@ def extract_series(rec: dict) -> Dict[str, float]:
             g = _gbps(row)
             if g is not None and g > 0:
                 series[f"{cat}:{name}"] = g
-    body = rec.get("serving_rows")
-    if isinstance(body, dict):
+    # serving + scenario rows: GB/s-under-SLO is the series (raw
+    # gbps as the fallback for rows predating the field)
+    for section, cat in (("serving_rows", "serving"),
+                         ("scenario_rows", "scenario")):
+        body = rec.get(section)
+        if not isinstance(body, dict):
+            continue
         for name, row in sorted(body.items()):
             if not isinstance(row, dict):
                 continue
@@ -99,7 +111,7 @@ def extract_series(rec: dict) -> Dict[str, float]:
                     and not isinstance(g, bool)):
                 g = _gbps(row)
             if g is not None and g > 0:
-                series[f"serving:{name}"] = float(g)
+                series[f"{cat}:{name}"] = float(g)
     return series
 
 

@@ -111,6 +111,11 @@ run_row "row 10: device-plane profiler — per-program cost/roofline attribution
     -s $((1<<18)) --workload profile --batch 16 --iterations 4 \
     -e 1 --json
 
+run_row "row 11: production-day scenario — mixed client stream at SLO + churn storm + straggler recovery under mClock QoS arbitration (ISSUE 11; GB/s-under-SLO and p99 under contention, metric_version 8)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -s $((1<<14)) --workload scenario --requests 128 --batch 4 \
+    -e 1 --storm-events 6 --json
+
 run_row "row 5: 1M-PG bulk CRUSH sweep on device" \
     python tools/bulk_crush_row.py
 

@@ -33,8 +33,6 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-import numpy as np
-
 from ceph_tpu.chaos import (
     CRASH_SITES,
     BitFlip,
@@ -43,10 +41,10 @@ from ceph_tpu.chaos import (
     ShardErasure,
     TornWrite,
     TransientErrors,
-    inject,
 )
 from ceph_tpu.codes.registry import ErasureCodePluginRegistry
-from ceph_tpu.codes.stripe import HashInfo, StripeInfo, encode
+from ceph_tpu.codes.stripe import StripeInfo
+from ceph_tpu.scenario.runner import stage_damaged_objects
 from ceph_tpu.crush import (
     CrushBuilder,
     step_chooseleaf_indep,
@@ -128,17 +126,11 @@ def main(argv=None) -> int:
     width = k * ec.get_chunk_size(a.size)
     sinfo = StripeInfo(k, width)
 
-    # -- place + write ---------------------------------------------------
+    # -- place + write (staging via the shared scenario runner) ----------
     osdmap = build_cluster(n_hosts=n + 3, devs=2, size=n)
     _, _, acting, _ = osdmap.pg_to_up_acting_osds(1, a.ps)
-    rng = np.random.default_rng(a.seed)
-    originals, stores, hinfos, all_faults = [], [], [], []
-    for i in range(a.objects):
-        obj = rng.integers(0, 256, size=width * a.stripes,
-                           dtype=np.uint8).tobytes()
-        shards = encode(sinfo, ec, obj)
-        hinfo = HashInfo(n)
-        hinfo.append(0, shards)
+
+    def injectors_for(i: int) -> list:
         injectors = []
         if a.erasures:
             injectors.append(ShardErasure(n=a.erasures))
@@ -149,12 +141,11 @@ def main(argv=None) -> int:
         if a.torn and i == 0 and a.erasures:
             # tear the recovery write-back of the first erased shard
             injectors.append(TornWrite(n=1, keep=width // (2 * k)))
-        store, faults = inject(shards, injectors, seed=a.seed + i,
-                               chunk_size=sinfo.chunk_size)
-        originals.append(shards)
-        stores.append(store)
-        hinfos.append(hinfo)
-        all_faults.append(faults)
+        return injectors
+
+    originals, stores, hinfos, all_faults = stage_damaged_objects(
+        sinfo, ec, a.objects, seed=a.seed, stripes=a.stripes,
+        injectors_for=injectors_for)
 
     churn = (MapChurn(seed=a.seed, max_down=a.max_down, p_fire=0.6,
                       max_events=a.churn) if a.churn else None)

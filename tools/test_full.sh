@@ -93,6 +93,18 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python tools/cluster_demo.py --osds 10000 --pgs 2048 --events 30 \
     --measure-every 5 >/dev/null \
     || { echo "cluster_demo: 10k simulated-mesh gate failed"; exit 1; }
+# Scenario gates (ISSUE 11 / docs/SCENARIOS.md): the composed
+# production day — client traffic at SLO + churn storm + straggler
+# recovery under mClock QoS arbitration — must hold every gate at
+# rc 0 (byte-identical replay from the seed, byte-identical client
+# stream under contention, byte-identical heal, arbiter-on p99 AND
+# miss rate strictly better than the arbiter-off control), and a
+# past-budget damage mix must exit with the structured unrecoverable
+# report (rc 2).
+python tools/scenario_demo.py >/dev/null \
+    || { echo "scenario_demo: scenario gate failed"; exit 1; }
+python tools/scenario_demo.py --erasures 4 >/dev/null 2>&1
+[ $? -eq 2 ] || { echo "scenario_demo: expected unrecoverable rc 2"; exit 1; }
 # Simulated-mesh gate (ISSUE 8 / docs/PERF.md "Multi-chip data
 # plane"): the sharded engine tier must hold on an 8-way virtual CPU
 # mesh — trace audit of the sharded entry points (shard_map program
