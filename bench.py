@@ -102,7 +102,15 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # recovery/churn counters and the QoS ledger; correctness
 # (byte-verified stream, byte-identical heal, zero data loss) gates
 # in-workload.  Host-only on the tunnel-down error path, same loop.
-METRIC_VERSION = 8
+# v9 (ISSUE 12, XOR-scheduled composite decode): every decode row
+# gains `engine` (the tier select_matrix_engine routes the pattern's
+# composite matrix to: xor|mxu|pallas|xla|numpy) and `xor_schedule`
+# (schedule length, xor_ops vs dense_gf_ops, reduction_ratio,
+# transform — null when the XOR-density probe declines), so the line
+# records WHY a decode number moved; tools/bench_diff.py gains the
+# `composite_decode` category tracking the shec/clay decode rows with
+# its own noise floor.  Consumers reading only `gbps` are unaffected.
+METRIC_VERSION = 9
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -686,7 +694,14 @@ def main() -> int:
     decode_rows = {}
     for name, argv in DECODE_ROWS:
         try:
-            decode_rows[name] = _row_result(_run(argv), digits=3)
+            dres = _run(argv)
+            row = _row_result(dres, digits=3)
+            # metric_version 9: which engine tier ran the composite
+            # decode matrix, and the XOR schedule's stats when the
+            # probe scheduled it — the row records why it moved
+            row["engine"] = dres.get("engine")
+            row["xor_schedule"] = dres.get("xor_schedule")
+            decode_rows[name] = row
         except (Exception, SystemExit) as e:  # noqa: BLE001
             errors.append(f"decode/{name}: {type(e).__name__}: {e}")
             decode_rows[name] = None

@@ -172,6 +172,23 @@ GF_MXU_PRIMS = GF_XLA_PRIMS | frozenset({
     "convert_element_type",
 })
 
+# The XOR-scheduled kernel family (ISSUE 12, ops/xor_schedule.py +
+# ops/pallas_gf.py): scheduled programs are straight-line XOR/shift
+# chains over SWAR words — mul-free by construction (the xtime step
+# decomposes its feedback into shift taps) and gather-free like every
+# GF program.  A ``mul`` or table-gather appearing in a scheduled
+# program is a FINDING: it means the schedule leaked back into the
+# dense multiply path the scheduler exists to replace.
+GF_XOR_PRIMS = frozenset({
+    "pjit", "bitcast_convert_type", "reshape", "broadcast_in_dim",
+    "concatenate", "slice", "squeeze", "transpose",
+    "xor", "and", "or", "shift_left", "shift_right_logical",
+})
+
+GF_XOR_PALLAS_PRIMS = GF_XOR_PRIMS | frozenset({
+    "pallas_call", "get", "swap", "convert_element_type", "pad",
+})
+
 # The mesh-sharded engine tier (ISSUE 8, parallel/plane.py): the same
 # GF program per shard under ONE shard_map, plus the zero-stripe pad
 # for non-dividing batches.  Anything else appearing in a sharded
@@ -306,6 +323,88 @@ def _build_pallas_bitmatrix() -> Built:
                                                   True),
                  (np.zeros((B, 4, w * packetsize), np.uint8),),
                  apply_bitmatrix_pallas)
+
+
+# representative XOR schedules (ops/xor_schedule.py): one CSE
+# schedule that exercises the xtime plane chain (an entry of 2 forces
+# one doubling), one ring-transform schedule (monomial matrix: shift
+# pairs + the feedback fold) that the probe actually PREFERS — both
+# deterministic pure functions of the pinned matrices
+
+def _xor_cse_static():
+    from ..ops.xor_schedule import build_schedule
+
+    ms = ((1, 1, 1, 1, 0, 0, 0), (0, 0, 1, 1, 1, 1, 0),
+          (2, 0, 0, 0, 1, 1, 1))
+    return build_schedule(ms).static
+
+
+def _xor_ring_static():
+    from ..ops.xor_schedule import build_schedule
+
+    ms = ((1, 1, 1, 1, 1, 1, 1), (1, 2, 4, 8, 16, 32, 64))
+    sched = build_schedule(ms)
+    assert sched.transform == "ring", sched.transform
+    return sched.static
+
+
+def _build_xor_pallas() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_matrix_xor_pallas
+
+    sched = _xor_cse_static()
+    return Built(lambda x: apply_matrix_xor_pallas(x, sched, True),
+                 (np.zeros((B, 7, C), np.uint8),),
+                 apply_matrix_xor_pallas)
+
+
+def _build_xor_packed() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_matrix_xor_packed
+
+    sched = _xor_ring_static()
+    return Built(lambda x: apply_matrix_xor_packed(x, sched, True),
+                 (np.zeros((B, 7, R, 128), np.uint32),),
+                 apply_matrix_xor_packed)
+
+
+def _build_xor_xla() -> Built:
+    import numpy as np
+
+    from ..ops.pallas_gf import apply_matrix_xor_xla
+
+    sched = _xor_cse_static()
+    return Built(lambda x: apply_matrix_xor_xla(x, sched),
+                 (np.zeros((B, 7, C), np.uint8),),
+                 apply_matrix_xor_xla)
+
+
+def _build_bitmatrix_xor() -> Built:
+    """The CSE-scheduled packet-layout kernel, on a bitmatrix whose
+    greedy sharing actually pays (cauchy_orig k=4,m=2 — the probe
+    schedules it; the audit fails loudly if that stops being true,
+    because the entry would then trace the WRONG kernel)."""
+    import numpy as np
+
+    from ..codes.registry import ErasureCodePluginRegistry
+    from ..ops.pallas_gf import apply_bitmatrix_xor_pallas
+    from ..ops.xla_ops import bitmatrix_to_static
+    from ..ops.xor_schedule import probe_bitmatrix_schedule
+
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jerasure", {"technique": "cauchy_orig", "k": "4", "m": "2",
+                     "packetsize": "512"})
+    rows = bitmatrix_to_static(ec.bitmatrix)
+    sched = probe_bitmatrix_schedule(rows, ec.w)
+    assert sched is not None, "cauchy_orig bitmatrix must schedule"
+    w, packetsize = ec.w, 512
+    return Built(
+        lambda x: apply_bitmatrix_xor_pallas(x, sched.static, w,
+                                             packetsize, True),
+        (np.zeros((B, 4, w * packetsize), np.uint8),),
+        apply_bitmatrix_xor_pallas)
 
 
 def _build_fused_repair() -> Built:
@@ -694,6 +793,22 @@ def registry() -> Tuple[EntryPoint, ...]:
         EntryPoint("ops.apply_matrix_mxu", "ops", "jit",
                    _build_apply_matrix_mxu, allow=GF_MXU_PRIMS,
                    float_ok=MXU_FLOAT_OK, trace_budget=16),
+        # the XOR-scheduled kernel family (ISSUE 12): interpret-mode
+        # Pallas (byte + packed) and the XLA build of the same
+        # schedules, pinned to the XOR-only allowlist — a mul or
+        # gather in a scheduled program is a finding forever
+        EntryPoint("ops.apply_matrix_xor_pallas", "ops", "jit",
+                   _build_xor_pallas, allow=GF_XOR_PALLAS_PRIMS,
+                   trace_budget=16),
+        EntryPoint("ops.apply_matrix_xor_packed", "ops", "jit",
+                   _build_xor_packed, allow=GF_XOR_PALLAS_PRIMS,
+                   trace_budget=16),
+        EntryPoint("ops.apply_matrix_xor_xla", "ops", "jit",
+                   _build_xor_xla, allow=GF_XOR_PRIMS,
+                   trace_budget=16),
+        EntryPoint("ops.apply_bitmatrix_xor", "ops", "jit",
+                   _build_bitmatrix_xor, allow=GF_XOR_PALLAS_PRIMS,
+                   trace_budget=16),
         EntryPoint("engine.fused_repair_call", "engine", "jit",
                    _build_fused_repair, allow=GF_XLA_PRIMS,
                    trace_budget=16),

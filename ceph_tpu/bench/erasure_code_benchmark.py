@@ -314,6 +314,65 @@ class ErasureCodeBench:
         return select_matrix_engine((1, len(ms[0]), 1, 128), ms, 8,
                                     packed=True)
 
+    @staticmethod
+    def _decode_matrix_static(ec, available, pat):
+        """The static composite/plan decode matrix the (available,
+        erased) pattern actually runs, across the plugin families:
+        clay/lrc probed composites, shec's minimum-read plan matrix,
+        the mixin decode matrix.  None when the plugin has no matrix
+        surface (bitmatrix techniques)."""
+        available, pat = tuple(available), tuple(pat)
+        comp = getattr(ec, "_decode_composite", None)
+        if comp is not None:
+            try:
+                return comp(available, pat)[1]
+            except Exception:  # noqa: BLE001 - advisory probe only
+                return None
+        tcache = getattr(ec, "tcache", None)
+        if tcache is not None and hasattr(ec, "_plan_static"):  # shec
+            try:
+                plan = tcache.get_plan(ec.matrix, ec.k, ec.w,
+                                       frozenset(available),
+                                       frozenset(pat))
+                return ec._plan_static(plan)[1]
+            except Exception:  # noqa: BLE001 - advisory probe only
+                return None
+        dm = getattr(ec, "_decode_matrix", None)
+        if dm is not None:
+            try:
+                return dm(available, pat)[1]
+            except Exception:  # noqa: BLE001 - advisory probe only
+                return None
+        return None
+
+    def _decode_row_meta(self, ec, available, pat, packed: bool) -> dict:
+        """metric_version 9 decode-row provenance: which engine tier
+        the decode matrix routes to and, when the XOR-density probe
+        schedules it, the schedule stats (length, xor_ops vs dense
+        gf_ops, reduction ratio) — so the bench line records WHY a
+        number moved, not just that it did.  --device host rows pin
+        engine="numpy" without touching jax (select_matrix_engine is a
+        pure function under an explicit engine override)."""
+        if getattr(ec, "w", 8) != 8:
+            return {"engine": "xla", "xor_schedule": None}
+        ms = self._decode_matrix_static(ec, available, pat)
+        if ms is None:
+            return {"engine": None, "xor_schedule": None}
+        from ceph_tpu.ops.pallas_gf import select_matrix_engine
+        from ceph_tpu.ops.xor_schedule import probe_schedule
+        chunk = ec.get_chunk_size(self.args.size)
+        cols = len(ms[0])
+        if packed:
+            shape = (self.args.batch, cols, max(1, chunk // 512), 128)
+        else:
+            shape = (self.args.batch, cols, chunk)
+        override = "numpy" if self.args.device == "host" else None
+        eng = select_matrix_engine(shape, ms, 8, packed=packed,
+                                   engine=override, mesh=0)
+        sched = probe_schedule(ms, 8)
+        return {"engine": eng,
+                "xor_schedule": sched.stats() if sched else None}
+
     def _instance(self):
         registry = ErasureCodePluginRegistry.instance()
         ec = registry.factory(self.args.plugin, dict(self.profile))
@@ -547,7 +606,9 @@ class ErasureCodeBench:
             elapsed = time.perf_counter() - begin
             lat.record(elapsed)  # --loop is ONE chained dispatch
             total_bytes = data.nbytes * n_slabs * reps
-            return self._result("decode", elapsed, total_bytes, lat)
+            res = self._result("decode", elapsed, total_bytes, lat)
+            res.update(self._decode_row_meta(ec, available, pat, packed))
+            return res
         if a.device == "jax":
             import jax
             dev = jax.device_put(allchunks)
@@ -580,7 +641,12 @@ class ErasureCodeBench:
                     survivors, available, pat))
             elapsed = time.perf_counter() - begin
         total_bytes = data.nbytes * a.iterations
-        return self._result("decode", elapsed, total_bytes, lat)
+        res = self._result("decode", elapsed, total_bytes, lat)
+        pat0 = patterns[0]
+        res.update(self._decode_row_meta(
+            ec, tuple(i for i in range(n) if i not in pat0), pat0,
+            packed=False))
+        return res
 
     # -- output -------------------------------------------------------------
 
@@ -1274,14 +1340,37 @@ class ErasureCodeBench:
             ]
             for opname, rows_, cols_, fn in ops:
                 key = ("bench.profile", plugin_cls, opname)
+                # the analytic model extended to XOR schedules
+                # (ISSUE 12): when the decode matrix the pattern
+                # actually runs is XOR-scheduled, the cost side
+                # carries the schedule's REAL op count (and the row
+                # says engine="xor"), so host-only rounds report the
+                # FLOP reduction, not the dense fiction
+                cost = profmod.analytic_matrix_cost(
+                    a.batch, rows_, cols_, chunk_size)
+                host_engine = "host"
+                ms = (self._decode_matrix_static(ec, available, pat)
+                      if opname == "decode"
+                      and getattr(ec, "w", 8) == 8 else None)
+                if ms is not None:
+                    from ..ops.xor_schedule import preferred_schedule
+                    mr, mc = len(ms), len(ms[0])
+                    unit = chunk_size // getattr(ec, "sub_chunk_no", 1)
+                    sched = preferred_schedule(ms, 8)
+                    if sched is not None:
+                        cost = profmod.analytic_xor_schedule_cost(
+                            a.batch, mr, mc, unit, sched.vpu_ops)
+                        host_engine = "xor"
+                    else:
+                        cost = profmod.analytic_matrix_cost(
+                            a.batch, mr, mc, unit)
                 prof.capture(
                     key, name=f"host.{opname}", platform="cpu",
-                    cost=profmod.analytic_matrix_cost(
-                        a.batch, rows_, cols_, chunk_size),
+                    cost=cost,
                     arg_bytes=a.batch * cols_ * chunk_size,
                     plugin=plugin_cls, kind=f"host-{opname}",
                     pattern="e" + "_".join(map(str, pat)),
-                    engine="host", devices=0, batch=a.batch)
+                    engine=host_engine, devices=0, batch=a.batch)
                 fn()                    # warm caches
             begin = time.perf_counter()
             for _ in range(a.iterations):

@@ -78,6 +78,86 @@ STANDARD_MATRIX: List[Tuple[str, Dict[str, str]]] = [
 
 DEFAULT_SIZE = 24041  # odd, not chunk-aligned: exercises padding paths
 
+# ---- composite-decode cost guard (ISSUE 12) ---------------------------
+#
+# Before the XOR-scheduled kernel family, the composite-decode path
+# tolerated an 8-38x modeled-cost gap vs the RS decode row as the
+# status quo.  These thresholds are RATCHETED to the post-ISSUE-12
+# numbers (measured 2026-08: worst shec/clay/lrc single-erasure
+# pattern models at 1.17x the RS k=8,m=3 e2 reference; shec data-
+# erasure plans and lrc composites XOR-schedule to <= 0.8 ops per
+# input column) so a regression in the scheduler, the probe, or the
+# composite constructions fails the corpus check loudly instead of
+# silently reopening the gap.
+
+# per-pattern ceiling: modeled best-tier vector ops per input column,
+# relative to the RS k=8,m=3 two-erasure decode matrix's dense model
+COMPOSITE_DECODE_MAX_RATIO = 1.5
+# the scheduler-alive ratchet: shec/lrc corpus profiles must keep at
+# least one single-erasure pattern on the XOR tier at or below this
+# ops-per-column cost (pure XOR chains measure 0.3-0.8)
+XOR_PLAN_MAX_OPS_PER_COL = 1.5
+
+
+def _rs_reference_cost_per_col() -> float:
+    """Dense modeled cost/column of the RS k=8,m=3 e=(0,1) decode
+    matrix — the denominator of the composite-decode ratio (the same
+    row BENCH decode_rows and the ISSUE 12 acceptance compare
+    against)."""
+    from ..ops.xor_schedule import dense_vpu_cost
+    ec = _factory("jerasure", {"technique": "reed_sol_van",
+                               "k": "8", "m": "3"})
+    _, ms, _ = ec._decode_matrix(
+        tuple(i for i in range(11) if i not in (0, 1)), (0, 1))
+    return dense_vpu_cost(ms) / len(ms[0])
+
+
+def composite_decode_guard(dirpath: str, plugin: str, ec) -> List[str]:
+    """Section 5 of check(): the modeled composite-decode cost ratchet
+    (runs for shec/clay/lrc corpus entries; numbers above).  Purely
+    host-side and deterministic — no jax, no device."""
+    from ..ops.xor_schedule import dense_vpu_cost, preferred_schedule
+    from .erasure_code_benchmark import ErasureCodeBench
+
+    errors: List[str] = []
+    if getattr(ec, "w", 8) != 8:
+        return errors
+    ref = _rs_reference_cost_per_col()
+    n = ec.get_chunk_count()
+    best_sched_cost = None
+    for e in range(n):
+        avail = tuple(i for i in range(n) if i != e)
+        ms = ErasureCodeBench._decode_matrix_static(ec, avail, (e,))
+        if ms is None:
+            continue
+        cols = len(ms[0])
+        cost = dense_vpu_cost(ms) / cols
+        sched = preferred_schedule(ms, 8)
+        if sched is not None:
+            sched_cost = sched.vpu_ops / cols
+            cost = min(cost, sched_cost)
+            best_sched_cost = (sched_cost if best_sched_cost is None
+                               else min(best_sched_cost, sched_cost))
+        ratio = cost / ref
+        if ratio > COMPOSITE_DECODE_MAX_RATIO:
+            errors.append(
+                f"{dirpath}: composite-decode cost regression: pattern "
+                f"({e},) models at {ratio:.2f}x the RS decode reference "
+                f"(> {COMPOSITE_DECODE_MAX_RATIO}x ratchet); "
+                f"cost/col={cost:.1f}, ref={ref:.1f}")
+    if plugin in ("shec", "lrc"):
+        if best_sched_cost is None:
+            errors.append(
+                f"{dirpath}: XOR scheduler regression: no single-"
+                f"erasure pattern routes to the XOR tier (shec/lrc "
+                f"plan decodes must stay scheduled — ISSUE 12)")
+        elif best_sched_cost > XOR_PLAN_MAX_OPS_PER_COL:
+            errors.append(
+                f"{dirpath}: XOR schedule cost regression: best "
+                f"scheduled pattern costs {best_sched_cost:.2f} "
+                f"ops/col (> {XOR_PLAN_MAX_OPS_PER_COL} ratchet)")
+    return errors
+
 
 def profile_dir_name(plugin: str, profile: Dict[str, str]) -> str:
     """Content-addressed directory name (profile order-independent)."""
@@ -220,6 +300,13 @@ def check(dirpath: str, decode_pairs: bool = True) -> List[str]:
                 errors.append(
                     f"{dirpath}: composite decode ({e},) chunk {e} "
                     f"mismatch")
+    # 5. composite-decode cost ratchet (ISSUE 12): the modeled
+    #    per-pattern decode cost must stay within the post-XOR-
+    #    schedule envelope of the RS reference — a scheduler/probe/
+    #    composite regression fails here loudly instead of silently
+    #    reopening the 8-38x gap
+    if plugin in ("shec", "clay", "lrc"):
+        errors.extend(composite_decode_guard(dirpath, plugin, ec))
     return errors
 
 

@@ -358,13 +358,16 @@ class ErasureCodeClay(ErasureCode):
 
     def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
         """(batch, k, chunk) -> (batch, m, chunk) via the probed composite
-        encode matrix (one GF(2^8) matrix application)."""
-        M = self._probe_encode_matrix()
+        encode matrix (one GF(2^8) matrix application; the host tier
+        runs the identical XOR schedule when the probe prefers one —
+        ops/xor_schedule.py)."""
+        from ...ops.xor_schedule import host_matrix_apply
+        M, ms = self._encode_composite()
         b, k, chunk = data.shape
         sub = self.sub_chunk_no
         sc = chunk // sub
         x = data.reshape(b, k * sub, sc)
-        y = regionops.matrix_encode(x, M, W)
+        y = host_matrix_apply(x, M, ms, W)
         return y.reshape(b, self.m, chunk)
 
     # -- minimum_to_decode (ErasureCodeClay.cc -> minimum_to_decode) --------
@@ -493,12 +496,13 @@ class ErasureCodeClay(ErasureCode):
                             erased: tuple) -> np.ndarray:
         """(batch, len(available), chunk) -> (batch, len(erased), chunk)
         via a probed per-pattern composite decode matrix."""
-        M = self._probe_decode_matrix(tuple(available), tuple(erased))
+        from ...ops.xor_schedule import host_matrix_apply
+        M, ms = self._decode_composite(tuple(available), tuple(erased))
         b, na, chunk = chunks.shape
         sub = self.sub_chunk_no
         sc = chunk // sub
         x = np.ascontiguousarray(chunks).reshape(b, na * sub, sc)
-        y = regionops.matrix_encode(x, M, W)
+        y = host_matrix_apply(x, M, ms, W)
         return y.reshape(b, len(erased), chunk)
 
     # -- repair (ErasureCodeClay.cc -> repair / repair_one_lost_chunk) ------

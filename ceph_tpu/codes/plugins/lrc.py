@@ -468,9 +468,13 @@ class ErasureCodeLrc(ErasureCode):
         return hit
 
     def encode_chunks_batch(self, data: np.ndarray) -> np.ndarray:
-        """(batch, k, C) -> (batch, n-k, C) parity in position order."""
+        """(batch, k, C) -> (batch, n-k, C) parity in position order
+        (host tier: the identical XOR schedule when the probe prefers
+        one — ops/xor_schedule.py)."""
+        from ...ops.xor_schedule import host_matrix_apply
         M, _ = self._probe_encode_matrix()
-        return regionops.matrix_encode(np.ascontiguousarray(data), M, W)
+        return host_matrix_apply(np.ascontiguousarray(data), M,
+                                 self._encode_static(), W)
 
     def _decode_composite(self, available: tuple, erased: tuple):
         """(M, static) for the probed per-pattern composite decode
@@ -507,8 +511,9 @@ class ErasureCodeLrc(ErasureCode):
 
     def decode_chunks_batch(self, chunks: np.ndarray, available: tuple,
                             erased: tuple) -> np.ndarray:
-        M = self._probe_decode_matrix(tuple(available), tuple(erased))
-        return regionops.matrix_encode(np.ascontiguousarray(chunks), M, W)
+        from ...ops.xor_schedule import host_matrix_apply
+        M, ms = self._decode_composite(tuple(available), tuple(erased))
+        return host_matrix_apply(np.ascontiguousarray(chunks), M, ms, W)
 
     # -- device-resident paths ----------------------------------------------
 

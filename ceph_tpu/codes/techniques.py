@@ -73,8 +73,16 @@ class MatrixCodeMixin:
             perf.inc("ec_host_calls")
             perf.inc("ec_host_bytes", chunks.nbytes)
             with record_dispatch("ec_apply", path="host"):
-                return regionops.matrix_encode(
-                    words, matrix, self.w).view(np.uint8)
+                # the numpy tier executes the IDENTICAL XOR schedule
+                # the device kernels run when the probe prefers one
+                # (ops/xor_schedule.py), so host-only rounds measure
+                # the same program shape; regionops stays the ground
+                # truth for everything else — byte-identical either
+                # way (corpus + fuzz pinned)
+                from ..ops.xor_schedule import host_matrix_apply
+                return host_matrix_apply(
+                    np.ascontiguousarray(chunks), matrix,
+                    matrix_static, self.w)
         perf.inc("ec_device_calls")
         perf.inc("ec_device_bytes", chunks.nbytes)
         with perf.timed("ec_device_time"), \

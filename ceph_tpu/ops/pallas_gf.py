@@ -389,6 +389,11 @@ def _run_matrix_packed(words: jax.Array, matrix_t, eng: str) -> jax.Array:
     dispatch body of apply_matrix_packed_best, shared with the mesh
     tier's per-shard callable)."""
     from . import xla_ops
+    if eng == "xor":
+        sched = _xor_sched_static(matrix_t)
+        if use_pallas() and pallas_matrix_packed_supported(words.shape):
+            return apply_matrix_xor_packed(words, sched)
+        return apply_matrix_xor_xla_packed(words, sched)
     if eng == "mxu":
         out = xla_ops.apply_matrix_mxu(_packed_to_bytes(words), matrix_t)
         return _bytes_to_packed(out)
@@ -498,6 +503,246 @@ def apply_bitmatrix_pallas(chunks: jax.Array, bitmatrix_rows, w: int,
     return out.reshape(lead + (r, c))
 
 
+# -- XOR-scheduled kernel family (ISSUE 12) ------------------------------
+#
+# The scheduler (ops/xor_schedule.py) turns a sparse/XOR-heavy
+# composite matrix into a straight-line program of full-width SWAR ops
+# (bit-matrix expansion -> greedy CSE, arxiv 2108.02692; polynomial-
+# ring lazy reduction for monomial matrices, arxiv 1701.07731).  The
+# kernels below EXECUTE that schedule: a Pallas variant per layout
+# (byte + packed resident words) and an XLA fallback built from the
+# same op list, all byte-identical to the dense kernels and to the
+# numpy tier (xor_schedule.apply_schedule_numpy runs the identical
+# schedule).  Scheduled programs are mul-free and gather-free by
+# construction — tpu-audit pins them to the XOR-only allowlist
+# (analysis/entrypoints.py GF_XOR_PRIMS).
+
+def _xor_matrix_kernel(sched_static, s: int, r: int, pack, unpack):
+    """Kernel body executing one XOR schedule over a (s, rt, LANE)
+    block: pack every input chunk to SWAR words in registers, run the
+    scheduled op list, unpack the output rows."""
+    from .xor_schedule import eval_schedule
+
+    def kernel(in_ref, out_ref):
+        ins = [pack(in_ref[0, j]) for j in range(s)]
+        outs = eval_schedule(sched_static, ins,
+                             lambda: jnp.zeros_like(ins[0]))
+        for i in range(r):
+            out_ref[0, i] = unpack(outs[i])
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def apply_matrix_xor_pallas(chunks: jax.Array, sched_static,
+                            interpret: bool = False) -> jax.Array:
+    """Byte-layout XOR-scheduled apply: (..., s, C) uint8 ->
+    (..., r, C), same contract (and same pad-and-mask row tiling) as
+    apply_matrix_pallas; the matrix is baked into ``sched_static``
+    (xor_schedule.XorSchedule.static)."""
+    _, s, r, _, _ = sched_static
+    assert chunks.shape[-2] == s and chunks.dtype == jnp.uint8
+    lead = chunks.shape[:-2]
+    c = chunks.shape[-1]
+    rows = c // LANE
+    b = int(np.prod(lead)) if lead else 1
+    tiles = chunks.reshape(b, s, rows, LANE)
+    pad = (-rows) % SUBLANE_U8
+    if pad:
+        tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    prows = rows + pad
+    rt = _row_tile8(prows)
+    out = pl.pallas_call(
+        _xor_matrix_kernel(sched_static, s, r,
+                           lambda v: _pack_words(v, interpret),
+                           lambda v: _unpack_words(v, interpret)),
+        grid=(b, prows // rt),
+        in_specs=[pl.BlockSpec((1, s, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, r, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, r, prows, LANE), jnp.uint8),
+        interpret=interpret,
+    )(tiles)
+    if pad:
+        out = out[..., :rows, :]
+    return out.reshape(lead + (r, c))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def apply_matrix_xor_packed(words: jax.Array, sched_static,
+                            interpret: bool = False) -> jax.Array:
+    """Packed-layout XOR-scheduled apply: (..., s, R, 128) uint32 ->
+    (..., r, R, 128) — the resident-word twin of
+    apply_matrix_pallas_packed (identity register pack, arbitrary row
+    counts via zero-pad + masked writeback)."""
+    _, s, r, _, _ = sched_static
+    assert words.shape[-3] == s and words.dtype == jnp.uint32
+    assert words.shape[-1] == LANE
+    lead = words.shape[:-3]
+    rows = words.shape[-2]
+    b = int(np.prod(lead)) if lead else 1
+    tiles = words.reshape(b, s, rows, LANE)
+    pad = (-rows) % SUBLANE_U32
+    if pad:
+        tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    prows = rows + pad
+    rt = _row_tile8(prows * 4) // 4
+    if rt == 0 or prows % rt:
+        rt = prows
+    ident = lambda v: v  # noqa: E731
+    out = pl.pallas_call(
+        _xor_matrix_kernel(sched_static, s, r, ident, ident),
+        grid=(b, prows // rt),
+        in_specs=[pl.BlockSpec((1, s, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, r, rt, LANE),
+                               lambda i, j: (i, 0, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, r, prows, LANE), jnp.uint32),
+        interpret=interpret,
+    )(tiles)
+    if pad:
+        out = out[..., :rows, :]
+    return out.reshape(lead + (r, rows, LANE))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def apply_matrix_xor_xla(chunks: jax.Array, sched_static) -> jax.Array:
+    """The XLA fallback built from the same schedule: (..., s, C)
+    uint8 (C % 4 == 0) -> (..., r, C).  Byte-identical to the Pallas
+    variant and the numpy tier by construction (one op list)."""
+    from .xor_schedule import eval_schedule
+
+    _, s, r, _, _ = sched_static
+    assert chunks.shape[-2] == s and chunks.dtype == jnp.uint8
+    c = chunks.shape[-1]
+    assert c % 4 == 0, c
+    words = jax.lax.bitcast_convert_type(
+        chunks.reshape(chunks.shape[:-1] + (c // 4, 4)), jnp.uint32)
+    ins = [words[..., j, :] for j in range(s)]
+    outs = eval_schedule(sched_static, ins,
+                         lambda: jnp.zeros_like(ins[0]))
+    out = jnp.stack(outs, axis=-2)
+    out = jax.lax.bitcast_convert_type(out, jnp.uint8)
+    return out.reshape(out.shape[:-2] + (c,))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def apply_matrix_xor_xla_packed(words: jax.Array,
+                                sched_static) -> jax.Array:
+    """Packed-layout XLA build of the schedule: (..., s, R, 128)
+    uint32 -> (..., r, R, 128), zero layout work."""
+    from .xor_schedule import eval_schedule
+
+    _, s, r, _, _ = sched_static
+    assert words.shape[-3] == s and words.dtype == jnp.uint32
+    ins = [words[..., j, :, :] for j in range(s)]
+    outs = eval_schedule(sched_static, ins,
+                         lambda: jnp.zeros_like(ins[0]))
+    return jnp.stack(outs, axis=-3)
+
+
+def _xor_sched_static(matrix_t):
+    """The schedule the selection table routed ``matrix_t`` to (the
+    probe is lru-cached, so this is a dict hit on the dispatch path)."""
+    from .xor_schedule import preferred_schedule
+    sched = preferred_schedule(matrix_t, 8, mxu_min=MXU_MATRIX_MIN)
+    assert sched is not None, "xor tier selected without a schedule"
+    return sched.static
+
+
+# -- scheduled bitmatrix (packet layout) ---------------------------------
+
+def _bitmatrix_xor_kernel(sched_static, s: int, w: int, r: int,
+                          rt: int):
+    """Packet-layout schedule body: inputs are the s*w packets of one
+    block, ops are pure XOR (CSE temps), outputs the r*w parity
+    packets."""
+    from .xor_schedule import eval_schedule_u8
+
+    n_in = sched_static[1]
+
+    def kernel(in_ref, out_ref):
+        ins = []
+        for idx in range(n_in):
+            j, lb = divmod(idx, w)
+            ins.append(in_ref[0, j, 0, lb * rt:(lb + 1) * rt, :])
+        outs = eval_schedule_u8(
+            sched_static, ins,
+            lambda: jnp.zeros((rt, LANE), jnp.uint8))
+        for row_idx in range(r * w):
+            i, l = divmod(row_idx, w)
+            out_ref[0, i, 0, l * rt:(l + 1) * rt, :] = outs[row_idx]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def apply_bitmatrix_xor_pallas(chunks: jax.Array, sched_static,
+                               w: int, packetsize: int,
+                               interpret: bool = False) -> jax.Array:
+    """XOR-scheduled packet-layout bitmatrix apply — the CSE'd twin
+    of apply_bitmatrix_pallas (same tiling gate:
+    pallas_bitmatrix_supported)."""
+    s = chunks.shape[-2]
+    c = chunks.shape[-1]
+    rw = sched_static[2]
+    r = rw // w
+    lead = chunks.shape[:-2]
+    b = int(np.prod(lead)) if lead else 1
+    nb = c // (w * packetsize)
+    rt = packetsize // LANE
+    tiles = chunks.reshape(b, s, nb, w * rt, LANE)
+    out = pl.pallas_call(
+        _bitmatrix_xor_kernel(sched_static, s, w, r, rt),
+        grid=(b, nb),
+        in_specs=[pl.BlockSpec((1, s, 1, w * rt, LANE),
+                               lambda i, j: (i, 0, j, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, r, 1, w * rt, LANE),
+                               lambda i, j: (i, 0, j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, r, nb, w * rt, LANE),
+                                       jnp.uint8),
+        interpret=interpret,
+    )(tiles)
+    return out.reshape(lead + (r, c))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def apply_bitmatrix_xor_xla(chunks: jax.Array, sched_static, w: int,
+                            packetsize: int) -> jax.Array:
+    """XLA build of a packet-layout bitmatrix schedule (same packet
+    assembly as xla_ops.apply_bitmatrix_xla, CSE temps shared)."""
+    from .xor_schedule import eval_schedule_u8
+
+    s = chunks.shape[-2]
+    c = chunks.shape[-1]
+    rw = sched_static[2]
+    r = rw // w
+    assert c % (w * packetsize) == 0, (c, w, packetsize)
+    nb = c // (w * packetsize)
+    dv = chunks.reshape(chunks.shape[:-2] + (s, nb, w, packetsize))
+    n_in = sched_static[1]
+    ins = []
+    for idx in range(n_in):
+        j, lb = divmod(idx, w)
+        ins.append(dv[..., j, :, lb, :])
+    outs = eval_schedule_u8(
+        sched_static, ins,
+        lambda: jnp.zeros(chunks.shape[:-2] + (nb, packetsize),
+                          jnp.uint8))
+    stacked = jnp.stack(outs, axis=-3)          # (..., rw, nb, p)
+    stacked = stacked.reshape(stacked.shape[:-3]
+                              + (r, w, nb, packetsize))
+    stacked = jnp.swapaxes(stacked, -3, -2)     # (..., r, nb, w, p)
+    return stacked.reshape(stacked.shape[:-4] + (r, c))
+
+
 def _device_kind() -> str:
     """Probed default-backend kind, via the explicit fallback policy
     (ops/fallback.py — specific exception types only, no silent
@@ -558,9 +803,16 @@ def select_matrix_engine(shape, matrix_t, w: int = 8,
                 apply runs under shard_map with the batch sharded
                 over the mesh and the matrix replicated, the
                 single-device tier below executing per shard.
+    - "xor":    w=8 matrix whose XOR schedule (ops/xor_schedule.py:
+                bit-matrix expansion + greedy CSE, ring transform for
+                monomial matrices) beats the dense-multiply cost
+                model — the scheduled kernel family runs it (Pallas
+                on TPU, the XLA build elsewhere; shec plan matrices,
+                lrc probed composites, parity-only patterns).
     - "mxu":    w=8 composite matrix with >= MXU_MATRIX_MIN nonzeros
                 on a Pallas-capable backend — the bit-sliced GF(2)
-                matmul (clay's 64x704 single-erasure composite).
+                matmul (clay's 64x704 single-erasure composite) —
+                unless the XOR schedule undercuts it.
     - "pallas": the bit-sliced VPU kernel (byte, padded-byte, packed,
                 or word variant per layout/w) on a TPU backend.
     - "xla":    the SWAR XLA path (non-TPU backends, or shapes no
@@ -584,6 +836,16 @@ def select_matrix_engine(shape, matrix_t, w: int = 8,
     if (plane is not None and plane.n_devices > 1
             and len(shape) >= (4 if packed else 3) and shape[0] >= 2):
         return "mesh"
+    # the XOR-density probe (ops/xor_schedule.py): a schedulable w=8
+    # matrix whose scheduled op count beats the dense-multiply model
+    # runs the scheduled kernel family on BOTH device tiers (Pallas on
+    # TPU, the XLA build of the same schedule elsewhere)
+    if (w == 8 and matrix_t
+            and (packed or (len(shape) >= 2 and shape[-1] % 4 == 0))):
+        from .xor_schedule import preferred_schedule
+        if preferred_schedule(matrix_t, 8,
+                              mxu_min=MXU_MATRIX_MIN) is not None:
+            return "xor"
     if engine != "pallas":
         return "xla"
     nnz = _matrix_nnz(matrix_t) if matrix_t else 0
@@ -607,6 +869,12 @@ def _run_matrix_bytes(chunks: jax.Array, matrix_t, w: int,
     per-shard callable)."""
     from . import xla_ops
     from .xla_ops import apply_matrix_xla
+    if eng == "xor":
+        sched = _xor_sched_static(matrix_t)
+        if use_pallas() and pallas_matrix_padded_supported(chunks.shape,
+                                                          8):
+            return apply_matrix_xor_pallas(chunks, sched)
+        return apply_matrix_xor_xla(chunks, sched)
     if eng == "mxu":
         # module attribute (not a local import) so the routing test
         # can observe which engine was selected
@@ -710,11 +978,22 @@ def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8,
 
 def apply_bitmatrix_best(chunks: jax.Array, bitmatrix_rows, w: int,
                          packetsize: int) -> jax.Array:
-    """Dispatch for packet-layout bitmatrix codes: Pallas on TPU when
-    the packets tile, XLA otherwise.  Byte-identical either way."""
+    """Dispatch for packet-layout bitmatrix codes: the CSE-scheduled
+    kernel when the greedy sharing pays (ops/xor_schedule.py ::
+    probe_bitmatrix_schedule — jerasure's smart-scheduling analog),
+    the plain packet kernel otherwise; Pallas on TPU when the packets
+    tile, XLA elsewhere.  Byte-identical in every branch."""
     from .xla_ops import apply_bitmatrix_xla
+    from .xor_schedule import probe_bitmatrix_schedule
+    sched = probe_bitmatrix_schedule(tuple(bitmatrix_rows), w)
     if (use_pallas()
             and pallas_bitmatrix_supported(chunks.shape, w, packetsize)):
+        if sched is not None:
+            return apply_bitmatrix_xor_pallas(chunks, sched.static, w,
+                                              packetsize)
         return apply_bitmatrix_pallas(chunks, bitmatrix_rows, w,
                                       packetsize)
+    if sched is not None:
+        return apply_bitmatrix_xor_xla(chunks, sched.static, w,
+                                       packetsize)
     return apply_bitmatrix_xla(chunks, bitmatrix_rows, w, packetsize)

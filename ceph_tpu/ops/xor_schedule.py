@@ -1,0 +1,685 @@
+"""XOR scheduler for composite GF(2^8) matrices — the compile side of
+the XOR-scheduled kernel family (ISSUE 12, ROADMAP item 1).
+
+The composite-matrix decode path (shec plan matrices, clay/lrc probed
+composites, the mixin decode matrices) pays the dense unrolled
+xtime/XOR kernel — or the MXU matmul — for matrices that are mostly
+sparse and XOR-heavy.  This module turns ONE static (r, s) GF(2^8)
+matrix into a straight-line program of full-width SWAR word ops that
+computes the identical product with (often far) fewer vector ops:
+
+1. **Bit-matrix expansion** — every entry e becomes its 8×8 GF(2)
+   bit-matrix (gf/bitmatrix.py), so each output bit of the product is
+   one XOR equation over "doubling planes" P(j, t) = xtime^t(in_j).
+   Grouping the 8 bit-equations of an output byte back onto the plane
+   domain keeps every op full-width (4 field bytes per uint32 lane —
+   no 1-bit-per-byte lane waste).
+2. **Common-subexpression elimination** ("Accelerating XOR-based
+   Erasure Coding using Program Optimization Techniques", arxiv
+   2108.02692): a greedy pairwise-savings pass (Paar's algorithm) that
+   repeatedly folds the variable pair co-occurring in the most
+   equations into a shared temporary.  Deterministic given the matrix
+   (ties break on the smallest pair), bounded (top-K candidate scan,
+   temp budget), and monotone: every fold with count >= 2 strictly
+   reduces the XOR count, so the scheduled count can never exceed the
+   naive expansion (the property tests/test_xor_schedule.py pins).
+3. **Polynomial-ring transform** ("Fast XOR-based Erasure Coding
+   based on Polynomial Ring Transforms", arxiv 1701.07731) for
+   matrices whose nonzero entries all live in the monomial subset
+   {x^0..x^7} = {1, 2, 4, ..., 128}: the product is accumulated in
+   F2[x] with NO per-step field reduction — multiplication by x^sh is
+   a byte-local shift pair (low word + overflow word), accumulation is
+   pure XOR, and one shared two-level feedback fold per output row
+   reduces the extended polynomial back into GF(2^8).  The whole
+   product becomes pure XOR/shift chains; byte-identical to the field
+   product by linearity of the reduction.
+
+The cheaper of (2) and (3) wins; :func:`preferred_schedule` is the
+sparsity/XOR-density probe ``select_matrix_engine`` consults (lru-
+cached per static matrix, so the per-dispatch cost is a dict hit —
+the same idiom as ``_matrix_nnz``).  Schedules are derived from the
+per-pattern composite matrices the engine PatternCache already
+caches, so every warm pattern reuses its schedule and its jit trace.
+
+Execution lives in three tiers, all running the IDENTICAL schedule:
+
+- :func:`ops.pallas_gf.apply_matrix_xor_pallas` /
+  ``apply_matrix_xor_packed`` — the VMEM-resident Pallas kernels;
+- ``ops.pallas_gf.apply_matrix_xor_xla`` (+ packed) — the XLA
+  fallback built from the same op list;
+- :func:`apply_schedule_numpy` here — the numpy tier, so host-only
+  rounds measure the same program they report on.
+
+Everything in the emitted programs is XOR/AND/shift/bitcast — no
+``mul``, no table gather (the xtime step uses the shift-decomposed
+feedback ``t ^ t<<2 ^ t<<3 ^ t<<4`` instead of ``t * 0x1d``), which
+is what lets tpu-audit pin the scheduled entry points to an XOR-only
+primitive allowlist (analysis/entrypoints.py ``GF_XOR_PRIMS``).
+
+This module is numpy-only at import time (no jax), so the host tier
+and the AST/audit tooling can use it in jax-free environments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..gf.bitmatrix import bitmatrix_n_ones
+from ..gf.gf8 import GF8_POLY
+
+W = 8
+FEEDBACK = GF8_POLY & 0xFF                       # 0x1d
+FB_TAPS = tuple(b for b in range(W) if (FEEDBACK >> b) & 1)  # (0, 2, 3, 4)
+
+# modeled full-width vector-op costs (the probe's common currency —
+# every op below touches one whole (rows, 128) uint32 tile):
+XOR_COST = 1          # a ^ b
+SHIFT_COST = 2        # byte-local shift: shift + lane mask
+XT_COST = 10          # mul-free xtime: mask, 2 shifts, 4 tap shifts/xors
+DENSE_XT_COST = 5     # the dense kernel's mul-form xtime (hi*0x1d)
+
+# bit-matrix ones above which the probe declines to schedule (the
+# greedy CSE is bounded but not free; huge composites — clay's
+# k=8,m=4,d=11 512x5632 expansion is ~70k ones — stay on the
+# MXU/dense tiers their cost models already own)
+DEFAULT_MAX_ONES = 20000
+
+
+def _max_ones() -> int:
+    try:
+        return int(os.environ.get("CEPH_TPU_XOR_SCHED_MAX_ONES",
+                                  str(DEFAULT_MAX_ONES)))
+    except ValueError:
+        return DEFAULT_MAX_ONES
+
+
+# the XOR tier must WIN on the cost model, not tie it: schedule only
+# when scheduled_ops * DEN <= dense_ops * NUM (i.e. at most NUM/DEN
+# = 3/4 of the dense unrolled kernel's op count; integer ratio — no
+# float sneaks into GF-lane code)
+XOR_DENSE_CUTOVER = (3, 4)
+
+# greedy-CSE bounds: candidate pairs are scanned among the TOPK
+# most-shared variables per round (pairs below the horizon can save
+# at most 1 op each), and the temp budget caps total rounds
+CSE_TOPK = 128
+CSE_MAX_TEMPS = 4096
+
+# bitmatrix (packet-layout) codes: scheduled only when CSE saves at
+# least NUM/DEN = 1/10 of the naive XOR count (the plain kernel is
+# already pure XOR; a temp-free matrix gains nothing)
+BITMATRIX_MIN_SAVINGS = (1, 10)
+
+
+# ----------------------------------------------------------------------
+# schedule representation
+#
+# A schedule is a straight-line program over node ids: nodes
+# 0..n_in-1 are the inputs; op i defines node n_in+i.  Ops:
+#   ("xt",  src)       node = xtime(src)          (byte-local, w=8)
+#   ("shl", src, sh)   node = byte-local src << sh
+#   ("shr", src, sh)   node = byte-local src >> sh
+#   ("xor", a, b)      node = a ^ b
+# outputs: one node id per output row; -1 = all-zero row.
+# The hashable ``static`` tuple is what the jitted kernels key on.
+
+@dataclasses.dataclass(frozen=True)
+class XorSchedule:
+    """One scheduled matrix: the static program plus its cost model."""
+
+    static: tuple            # ("xorsched", n_in, n_out, ops, outputs)
+    n_in: int
+    n_out: int
+    n_ops: int               # schedule length (all emitted ops)
+    xor_ops: int             # pure XOR ops
+    plane_ops: int           # xtime / shift plane materializations
+    vpu_ops: int             # modeled full-width vector-op cost
+    naive_xor_ops: int       # XORs of the naive bit-matrix expansion
+    dense_gf_ops: int        # 2*r*s — the dense-multiply model
+    dense_vpu_ops: int       # modeled cost of the dense unrolled kernel
+    transform: str           # "cse" | "ring" | "bitcse"
+
+    @property
+    def reduction_ratio(self):
+        """Dense-model ops per scheduled op (>= 1.0 when scheduling
+        pays; the bench decode rows record it).  None for a zero-op
+        schedule (pure copies — the ratio is not meaningful and inf
+        is not valid JSON)."""
+        if not self.vpu_ops:
+            return None
+        # tpu-lint: disable=gf-float -- reporting-only ratio of two
+        # op COUNTS (cost-model stat), not GF symbol math
+        return round(self.dense_vpu_ops / self.vpu_ops, 3)
+
+    def stats(self) -> dict:
+        return {
+            "transform": self.transform,
+            "len": self.n_ops,
+            "xor_ops": self.xor_ops,
+            "plane_ops": self.plane_ops,
+            "vpu_ops": self.vpu_ops,
+            "naive_xor_ops": self.naive_xor_ops,
+            "dense_gf_ops": self.dense_gf_ops,
+            "dense_vpu_ops": self.dense_vpu_ops,
+            "reduction_ratio": self.reduction_ratio,
+        }
+
+
+class _Emitter:
+    """Accumulates ops + node ids with the cost model attached."""
+
+    COST = {"xt": XT_COST, "shl": SHIFT_COST, "shr": SHIFT_COST,
+            "xor": XOR_COST}
+
+    def __init__(self, n_in: int) -> None:
+        self.n_in = n_in
+        self.ops: List[tuple] = []
+        self.vpu_ops = 0
+        self.xor_ops = 0
+        self.plane_ops = 0
+
+    def emit(self, op: tuple) -> int:
+        self.ops.append(op)
+        kind = op[0]
+        self.vpu_ops += self.COST[kind]
+        if kind == "xor":
+            self.xor_ops += 1
+        else:
+            self.plane_ops += 1
+        return self.n_in + len(self.ops) - 1
+
+    def fold_xor(self, nodes: Sequence[int]) -> int:
+        """Left-fold a (sorted) node list into one XOR chain; -1 when
+        empty, the node itself when singleton."""
+        if not nodes:
+            return -1
+        acc = nodes[0]
+        for nid in nodes[1:]:
+            acc = self.emit(("xor", acc, nid))
+        return acc
+
+
+# ----------------------------------------------------------------------
+# greedy pairwise CSE (Paar) — deterministic, bounded
+
+def _greedy_cse(rows: List[Set[int]], n_vars: int,
+                max_temps: int = CSE_MAX_TEMPS,
+                topk: int = CSE_TOPK,
+                ) -> Tuple[List[Tuple[int, int]], List[List[int]]]:
+    """Fold the most-shared variable pair into a fresh temp until no
+    pair co-occurs twice (or the budget runs out).
+
+    ``rows`` are sets of variable ids (inputs 0..n_vars-1; temps get
+    ids n_vars, n_vars+1, ... in creation order).  Returns the temp
+    definitions ``[(a, b), ...]`` and the rewritten rows (sorted).
+    Every fold with count >= 2 removes ``count`` terms and adds one
+    op, so total XOR count is strictly decreasing — the monotonicity
+    the never-worse-than-naive property rests on."""
+    col: Dict[int, int] = {}
+    for ri, row in enumerate(rows):
+        bit = 1 << ri
+        for v in row:
+            col[v] = col.get(v, 0) | bit
+    temps: List[Tuple[int, int]] = []
+    next_var = n_vars
+    while len(temps) < max_temps:
+        cand = sorted((v for v in col if col[v].bit_count() >= 2),
+                      key=lambda v: (-col[v].bit_count(), v))[:topk]
+        best_cnt, best_pair = 1, None
+        for i, a in enumerate(cand):
+            ra = col[a]
+            if ra.bit_count() <= best_cnt:
+                break  # sorted descending: no later pair can beat it
+            for b in cand[i + 1:]:
+                c = (ra & col[b]).bit_count()
+                if c > best_cnt or (c == best_cnt and best_pair
+                                    and (a, b) < best_pair):
+                    best_cnt, best_pair = c, (a, b)
+        if best_pair is None or best_cnt < 2:
+            break
+        a, b = best_pair
+        mask = col[a] & col[b]
+        col[a] &= ~mask
+        col[b] &= ~mask
+        for v in (a, b):
+            if not col[v]:
+                del col[v]
+        col[next_var] = mask
+        temps.append((a, b))
+        next_var += 1
+    new_rows: List[List[int]] = [[] for _ in rows]
+    for v in sorted(col):
+        mask = col[v]
+        while mask:
+            ri = (mask & -mask).bit_length() - 1
+            new_rows[ri].append(v)
+            mask &= mask - 1
+    return temps, [sorted(row) for row in new_rows]
+
+
+# ----------------------------------------------------------------------
+# bit-equation extraction + naive/dense cost models
+
+def _bit_rows(matrix_t) -> List[Set[int]]:
+    """Row i -> the doubling-plane set {j*8+t : bit t of M[i][j]}."""
+    s = len(matrix_t[0])
+    rows: List[Set[int]] = []
+    for row in matrix_t:
+        planes: Set[int] = set()
+        for j in range(s):
+            e = int(row[j])
+            t = 0
+            while e:
+                if e & 1:
+                    planes.add(j * W + t)
+                e >>= 1
+                t += 1
+        rows.append(planes)
+    return rows
+
+
+def naive_bitmatrix_xors(matrix_t) -> int:
+    """XOR count of the naive full bit-matrix expansion: total ones of
+    the (r*8, s*8) GF(2) matrix minus its nonzero bit-rows — the
+    ceiling the property test holds every schedule under."""
+    ones = 0
+    nonzero_bit_rows = 0
+    for row in matrix_t:
+        ones += sum(bitmatrix_n_ones(int(e)) for e in row if e)
+        if any(int(e) for e in row):
+            nonzero_bit_rows += W  # every bit-row of a nonzero GF row
+    return max(0, ones - nonzero_bit_rows)
+
+
+def dense_vpu_cost(matrix_t) -> int:
+    """Modeled op count of the dense unrolled xtime/XOR kernel
+    (ops/pallas_gf.py::_matrix_kernel): per input column, the shared
+    doubling chain up to its highest used bit, plus one XOR per set
+    bit of every entry."""
+    r = len(matrix_t)
+    s = len(matrix_t[0])
+    cost = 0
+    for j in range(s):
+        col = [int(matrix_t[i][j]) for i in range(r)]
+        top = max((c.bit_length() for c in col), default=0)
+        if top > 1:
+            cost += DENSE_XT_COST * (top - 1)
+        cost += sum(c.bit_count() for c in col)
+    return cost
+
+
+def _monomial_shifts(matrix_t) -> Optional[List[List[Optional[int]]]]:
+    """sh[i][j] when every nonzero entry is x^sh (a power of two in
+    GF(2^8)); None when the matrix leaves the monomial subset."""
+    out: List[List[Optional[int]]] = []
+    for row in matrix_t:
+        sh_row: List[Optional[int]] = []
+        for e in row:
+            e = int(e)
+            if e == 0:
+                sh_row.append(None)
+            elif e & (e - 1):
+                return None
+            else:
+                sh_row.append(e.bit_length() - 1)
+        out.append(sh_row)
+    return out
+
+
+# ----------------------------------------------------------------------
+# schedule builders
+
+def _finish(em: _Emitter, outputs: List[int], matrix_t, naive: int,
+            dense_vpu: int, transform: str) -> XorSchedule:
+    r = len(matrix_t)
+    s = len(matrix_t[0])
+    static = ("xorsched", em.n_in, r, tuple(em.ops), tuple(outputs))
+    return XorSchedule(
+        static=static, n_in=em.n_in, n_out=r, n_ops=len(em.ops),
+        xor_ops=em.xor_ops, plane_ops=em.plane_ops,
+        vpu_ops=em.vpu_ops, naive_xor_ops=naive,
+        dense_gf_ops=2 * r * s, dense_vpu_ops=dense_vpu,
+        transform=transform)
+
+
+def _build_cse(matrix_t, naive: int, dense_vpu: int) -> XorSchedule:
+    s = len(matrix_t[0])
+    rows = _bit_rows(matrix_t)
+    # equations per output BYTE, on the doubling-plane domain: the 8
+    # bit-equations of a byte share planes heavily (they are the bit
+    # decomposition of one XOR-of-xtime-planes sum), so the byte-level
+    # rows ARE the grouped bit-matrix equations
+    temps, final_rows = _greedy_cse(rows, s * W)
+    n_planes = s * W
+    # which doubling planes must materialize: referenced by rows or by
+    # temp definitions (temps reference ORIGINAL operands permanently)
+    used: Set[int] = set()
+    for a, b in temps:
+        for v in (a, b):
+            if v < n_planes:
+                used.add(v)
+    for row in final_rows:
+        for v in row:
+            if v < n_planes:
+                used.add(v)
+    em = _Emitter(s)
+    node_of: Dict[int, int] = {}
+    max_t: Dict[int, int] = {}
+    for v in used:
+        j, t = divmod(v, W)
+        max_t[j] = max(max_t.get(j, 0), t)
+    for j in sorted(max_t):
+        node_of[j * W] = j               # plane t=0 IS the input
+        prev = j
+        for t in range(1, max_t[j] + 1):
+            prev = em.emit(("xt", prev))
+            node_of[j * W + t] = prev
+    for ti, (a, b) in enumerate(temps):
+        na, nb = node_of[a], node_of[b]
+        node_of[n_planes + ti] = em.emit(("xor", min(na, nb),
+                                          max(na, nb)))
+    outputs = [em.fold_xor([node_of[v] for v in row])
+               for row in final_rows]
+    return _finish(em, outputs, matrix_t, naive, dense_vpu, "cse")
+
+
+def _build_ring(matrix_t, shifts, naive: int,
+                dense_vpu: int) -> Optional[XorSchedule]:
+    """The 1701.07731 lazy-reduction schedule for monomial matrices:
+    accumulate out[i] = sum_j x^sh_ij * in_j in F2[x] as a (low,
+    overflow) byte-plane pair — shifts are byte-local shift pairs,
+    accumulation pure XOR — then fold the overflow through the
+    feedback taps once per output row (two levels close it for
+    0x11d: overflow bits <= 6, second-level bits <= 2)."""
+    r = len(matrix_t)
+    s = len(matrix_t[0])
+    # variable space for CSE over the L/H accumulations: one var per
+    # used (kind, j, sh) plane, enumerated deterministically
+    plane_vars: Dict[Tuple[str, int, int], int] = {}
+    lo_rows: List[Set[int]] = []
+    hi_rows: List[Set[int]] = []
+    for i in range(r):
+        lo: Set[int] = set()
+        hi: Set[int] = set()
+        for j in range(s):
+            sh = shifts[i][j]
+            if sh is None:
+                continue
+            lv = plane_vars.setdefault(("shl", j, sh), len(plane_vars))
+            lo.add(lv)
+            if sh > 0:
+                hv = plane_vars.setdefault(("shr", j, W - sh),
+                                           len(plane_vars))
+                hi.add(hv)
+        lo_rows.append(lo)
+        hi_rows.append(hi)
+    n_vars = len(plane_vars)
+    temps, folded = _greedy_cse(lo_rows + hi_rows, n_vars)
+    em = _Emitter(s)
+    node_of: Dict[int, int] = {}
+    for key, var in sorted(plane_vars.items(), key=lambda kv: kv[1]):
+        kind, j, sh = key
+        node_of[var] = j if sh == 0 else em.emit((kind, j, sh))
+    for ti, (a, b) in enumerate(temps):
+        na, nb = node_of[a], node_of[b]
+        node_of[n_vars + ti] = em.emit(("xor", min(na, nb),
+                                        max(na, nb)))
+
+    def fold_overflow(h: int) -> int:
+        """h carries polynomial bits 8.. as byte bits 0..; return its
+        GF(2^8) reduction h * (x^8 mod p) mod p as a node."""
+        terms = [h]
+        over = []
+        for tap in FB_TAPS[1:]:
+            terms.append(em.emit(("shl", h, tap)))
+            over.append(em.emit(("shr", h, W - tap)))
+        low = em.fold_xor(terms)
+        h2 = em.fold_xor(over)
+        # second level: overflow of the overflow (bits <= 2 for 0x11d
+        # — its shl taps cannot overflow again)
+        terms2 = [h2]
+        for tap in FB_TAPS[1:]:
+            terms2.append(em.emit(("shl", h2, tap)))
+        return em.emit(("xor", low, em.fold_xor(terms2)))
+
+    outputs: List[int] = []
+    for i in range(r):
+        lnode = em.fold_xor([node_of[v] for v in folded[i]])
+        hnode = em.fold_xor([node_of[v] for v in folded[r + i]])
+        if hnode == -1:
+            outputs.append(lnode)
+        elif lnode == -1:
+            outputs.append(fold_overflow(hnode))
+        else:
+            outputs.append(em.emit(("xor", lnode,
+                                    fold_overflow(hnode))))
+    return _finish(em, outputs, matrix_t, naive, dense_vpu, "ring")
+
+
+def build_schedule(matrix_t, w: int = 8) -> XorSchedule:
+    """Schedule one static (r, s) GF(2^8) matrix: the cheaper of the
+    CSE schedule and (for monomial-subset matrices) the ring-transform
+    schedule, deterministic given the matrix."""
+    if w != W:
+        raise ValueError(f"XOR scheduling is w=8 only, got w={w}")
+    if not matrix_t or not matrix_t[0]:
+        raise ValueError("empty matrix")
+    naive = naive_bitmatrix_xors(matrix_t)
+    dense_vpu = dense_vpu_cost(matrix_t)
+    sched = _build_cse(matrix_t, naive, dense_vpu)
+    shifts = _monomial_shifts(matrix_t)
+    if shifts is not None:
+        ring = _build_ring(matrix_t, shifts, naive, dense_vpu)
+        # ring wins only on the full cost model AND without breaking
+        # the never-worse-than-naive XOR property
+        if ring is not None and ring.vpu_ops < sched.vpu_ops \
+                and ring.xor_ops <= max(naive, sched.xor_ops):
+            sched = ring
+    return sched
+
+
+# ----------------------------------------------------------------------
+# the probe (what select_matrix_engine consults)
+
+@functools.lru_cache(maxsize=256)
+def probe_schedule(matrix_t, w: int = 8) -> Optional[XorSchedule]:
+    """Build-and-cache the schedule for a static matrix, or None when
+    the matrix is out of scope (w != 8, or its bit-matrix expansion
+    exceeds the scheduling budget — huge composites stay on the
+    MXU/dense tiers).  lru-cached on the hashable static tuple, so
+    the per-dispatch cost after the first call is a dict hit."""
+    if w != W or not matrix_t or not matrix_t[0]:
+        return None
+    ones = sum(bitmatrix_n_ones(int(e))
+               for row in matrix_t for e in row if e)
+    if ones == 0 or ones > _max_ones():
+        return None
+    return build_schedule(matrix_t, w)
+
+
+def preferred_schedule(matrix_t, w: int = 8,
+                       mxu_min: Optional[int] = None,
+                       ) -> Optional[XorSchedule]:
+    """The XOR-density decision: the schedule, iff the cost model says
+    it beats the dense unrolled kernel by the cutover margin — and,
+    above the MXU nonzero threshold (``mxu_min``), only when the
+    schedule also undercuts one op per nonzero (the regime where even
+    a systolic matmul loses to a structured XOR chain)."""
+    sched = probe_schedule(matrix_t, w)
+    if sched is None:
+        return None
+    num, den = XOR_DENSE_CUTOVER
+    if sched.vpu_ops * den > num * sched.dense_vpu_ops:
+        return None
+    if mxu_min is not None:
+        nnz = sum(1 for row in matrix_t for e in row if e)
+        if nnz >= mxu_min and sched.vpu_ops >= nnz:
+            return None
+    return sched
+
+
+# ----------------------------------------------------------------------
+# bitmatrix (packet-layout) CSE — the already-pure-XOR codes
+# (cauchy_*, liberation, blaum_roth, liber8tion) get the same greedy
+# sharing over packets; no planes, no folds — xor ops only
+
+@functools.lru_cache(maxsize=128)
+def probe_bitmatrix_schedule(rows_masks: tuple, w: int
+                             ) -> Optional[XorSchedule]:
+    """CSE over a jerasure packet-layout bitmatrix: inputs are the
+    s*w packets, outputs the r*w parity packets.  Returns a schedule
+    only when the sharing pays >= BITMATRIX_MIN_SAVINGS of the naive
+    XOR count (the plain kernel is already pure XOR)."""
+    rw = len(rows_masks)
+    if rw == 0 or rw % w:
+        return None
+    ncols = max((int(m).bit_length() for m in rows_masks), default=0)
+    if ncols == 0:
+        return None
+    s_in = ((ncols + w - 1) // w) * w
+    rows: List[Set[int]] = []
+    naive = 0
+    for m in rows_masks:
+        m = int(m)
+        row = set()
+        col = 0
+        while m:
+            if m & 1:
+                row.add(col)
+            m >>= 1
+            col += 1
+        naive += max(0, len(row) - 1)
+        rows.append(row)
+    temps, final_rows = _greedy_cse(rows, s_in)
+    em = _Emitter(s_in)
+    node_of: Dict[int, int] = {v: v for v in range(s_in)}
+    for ti, (a, b) in enumerate(temps):
+        na, nb = node_of[a], node_of[b]
+        node_of[s_in + ti] = em.emit(("xor", min(na, nb), max(na, nb)))
+    outputs = [em.fold_xor([node_of[v] for v in row])
+               for row in final_rows]
+    num, den = BITMATRIX_MIN_SAVINGS
+    if naive == 0 or (naive - em.xor_ops) * den < num * naive:
+        return None
+    static = ("xorsched", s_in, rw, tuple(em.ops), tuple(outputs))
+    return XorSchedule(
+        static=static, n_in=s_in, n_out=rw, n_ops=len(em.ops),
+        xor_ops=em.xor_ops, plane_ops=0, vpu_ops=em.vpu_ops,
+        naive_xor_ops=naive, dense_gf_ops=naive + rw,
+        dense_vpu_ops=naive, transform="bitcse")
+
+
+# ----------------------------------------------------------------------
+# execution — ONE evaluator shared by the numpy tier, the XLA builds
+# and the Pallas kernel bodies (numpy and jax arrays share the
+# operator surface; constants are np.uint32 scalars, so traced
+# programs stay weak-type-clean)
+
+_LMASK = tuple(int.from_bytes(bytes([(0xFF << sh) & 0xFF] * 4),
+                              "little") for sh in range(W))
+_RMASK = tuple(int.from_bytes(bytes([0xFF >> sh] * 4), "little")
+               for sh in range(W))
+
+
+def xtime_words_xor(v):
+    """Byte-local multiply-by-x on uint32 SWAR words, mul-free: the
+    feedback 0x1d is applied as ``t ^ t<<2 ^ t<<3 ^ t<<4`` (the taps
+    of GF8_POLY), so scheduled programs carry no ``mul`` primitive.
+    Byte-identical to xla_ops.xtime_swar8 by construction."""
+    hi = v & np.uint32(0x80808080)
+    t = hi >> np.uint32(W - 1)
+    out = (v ^ hi) << np.uint32(1)
+    for tap in FB_TAPS:
+        out = out ^ (t << np.uint32(tap)) if tap else out ^ t
+    return out
+
+
+def eval_schedule(static: tuple, inputs: Sequence, zero) -> list:
+    """Run one schedule over per-input word arrays.  ``inputs`` is a
+    list of n_in uint32 arrays (numpy, jax, or Pallas register
+    values); ``zero`` is a thunk producing an all-zero array for -1
+    outputs.  Returns the n_out output arrays in row order."""
+    _, n_in, _, ops, outputs = static
+    nodes = list(inputs)
+    for op in ops:
+        kind = op[0]
+        if kind == "xor":
+            nodes.append(nodes[op[1]] ^ nodes[op[2]])
+        elif kind == "xt":
+            nodes.append(xtime_words_xor(nodes[op[1]]))
+        elif kind == "shl":
+            nodes.append((nodes[op[1]] << np.uint32(op[2]))
+                         & np.uint32(_LMASK[op[2]]))
+        else:  # "shr"
+            nodes.append((nodes[op[1]] >> np.uint32(op[2]))
+                         & np.uint32(_RMASK[op[2]]))
+    return [nodes[o] if o >= 0 else zero() for o in outputs]
+
+
+def eval_schedule_u8(static: tuple, inputs: Sequence, zero) -> list:
+    """Pure-XOR schedule over uint8 packet arrays (the bitmatrix
+    packet layout); only ``xor`` ops are legal here."""
+    _, n_in, _, ops, outputs = static
+    nodes = list(inputs)
+    for op in ops:
+        assert op[0] == "xor", op
+        nodes.append(nodes[op[1]] ^ nodes[op[2]])
+    return [nodes[o] if o >= 0 else zero() for o in outputs]
+
+
+def apply_schedule_numpy(chunks: np.ndarray,
+                         sched: "XorSchedule | tuple") -> np.ndarray:
+    """The numpy tier: run the IDENTICAL schedule the device kernels
+    execute over (..., s, C) uint8 host chunks (C % 4 == 0) ->
+    (..., r, C).  Host-only rounds therefore measure — and report on —
+    the same program shape as the device path."""
+    static = sched.static if isinstance(sched, XorSchedule) else sched
+    _, s, r, _, _ = static
+    assert chunks.shape[-2] == s and chunks.dtype == np.uint8
+    c = chunks.shape[-1]
+    assert c % 4 == 0, c
+    words = np.ascontiguousarray(chunks).view(np.uint32)
+    ins = [words[..., j, :] for j in range(s)]
+    outs = eval_schedule(static, ins,
+                         lambda: np.zeros_like(words[..., 0, :]))
+    out = np.stack(outs, axis=-2)
+    return np.ascontiguousarray(out).view(np.uint8).reshape(
+        chunks.shape[:-2] + (r, c))
+
+
+def host_matrix_apply(chunks: np.ndarray, matrix: np.ndarray,
+                      matrix_static: Optional[tuple] = None,
+                      w: int = 8) -> np.ndarray:
+    """Host-tier matrix apply: the identical XOR schedule when the
+    probe prefers one, the regionops ground truth otherwise.  The two
+    are byte-identical (pinned by the fuzz tests and the corpus); the
+    schedule path simply makes host-only rounds run — and time — the
+    same program the device tiers dispatch."""
+    if w == W:
+        ms = matrix_static
+        if ms is None:
+            ms = tuple(tuple(int(x) for x in row)
+                       for row in np.asarray(matrix))
+        if chunks.shape[-1] % 4 == 0:
+            sched = preferred_schedule(ms, W)
+            if sched is not None:
+                return apply_schedule_numpy(
+                    np.ascontiguousarray(chunks), sched)
+    from . import regionops
+    words = regionops.words_view(np.ascontiguousarray(chunks), w)
+    return regionops.matrix_encode(words, matrix, w).view(np.uint8)
+
+
+__all__ = [
+    "XorSchedule", "apply_schedule_numpy", "build_schedule",
+    "dense_vpu_cost", "eval_schedule", "eval_schedule_u8",
+    "host_matrix_apply", "naive_bitmatrix_xors",
+    "preferred_schedule", "probe_bitmatrix_schedule",
+    "probe_schedule", "xtime_words_xor",
+    "XOR_DENSE_CUTOVER", "BITMATRIX_MIN_SAVINGS",
+]

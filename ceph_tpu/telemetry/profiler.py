@@ -113,6 +113,22 @@ def analytic_matrix_cost(batch: int, rows: int, cols: int,
             "bytes accessed": float(batch) * (rows + cols) * chunk_bytes}
 
 
+def analytic_xor_schedule_cost(batch: int, rows: int, cols: int,
+                               chunk_bytes: int,
+                               vpu_ops: int) -> Dict[str, float]:
+    """Cost model for an XOR-scheduled matrix apply (ISSUE 12,
+    ops/xor_schedule.py): the schedule is a straight-line program of
+    ``vpu_ops`` full-width vector ops, each touching one chunk-sized
+    tile — so flops = batch * vpu_ops * chunk_bytes (byte-ops), while
+    the HBM side is unchanged from the dense model (input read once,
+    output written once).  This is the "analytic model extended to
+    XOR schedules": host-only rounds report the scheduled program's
+    REAL op count, so the FLOP reduction the schedule buys is visible
+    in the same attribution rows the dense model feeds."""
+    return {"flops": float(batch) * vpu_ops * chunk_bytes,
+            "bytes accessed": float(batch) * (rows + cols) * chunk_bytes}
+
+
 def _normalize_cost(cost) -> Optional[Dict[str, float]]:
     """cost_analysis() shapes vary by jax version/stage: a dict at the
     Lowered stage, a one-element list of dicts at Compiled.  Normalize
@@ -486,7 +502,8 @@ def profiler_selftest() -> dict:
 
 
 __all__ = ["HBM_PEAK_GBPS", "ProgramProfiler", "ProgramRecord",
-           "analytic_matrix_cost", "capture_enabled",
-           "global_profiler", "profile_entrypoints",
-           "profiler_selftest", "resolve_peak_gbps",
-           "set_capture_enabled", "set_global_profiler"]
+           "analytic_matrix_cost", "analytic_xor_schedule_cost",
+           "capture_enabled", "global_profiler",
+           "profile_entrypoints", "profiler_selftest",
+           "resolve_peak_gbps", "set_capture_enabled",
+           "set_global_profiler"]

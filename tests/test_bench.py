@@ -261,7 +261,10 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    assert bench.METRIC_VERSION == 8
+    # metric_version 9 (ISSUE 12): decode rows carry engine +
+    # xor_schedule provenance; tools/bench_diff.py gains the
+    # composite_decode category (tests/test_xor_schedule.py pins both)
+    assert bench.METRIC_VERSION == 9
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
@@ -498,7 +501,11 @@ def test_profile_workload_host_analytic():
     assert res["programs"] == 2           # host encode + host decode
     for row in res["profile_rows"]:
         assert row["source"] == "analytic"
-        assert row["engine"] == "host"
+        # decode rows whose pattern matrix the XOR-density probe
+        # schedules carry engine="xor" with the schedule's real op
+        # count (ISSUE 12 — the analytic model extended to XOR
+        # schedules); everything else stays "host"
+        assert row["engine"] in ("host", "xor")
         assert row["flops"] > 0 and row["bytes_accessed"] > 0
         assert row["p50_ms"] > 0 and row["achieved_gbps"] > 0
     assert res["gbps"] > 0

@@ -147,13 +147,28 @@ def test_engine_selection_table():
     directly: shec/lrc-sized composites ride the packed Pallas kernel
     when the device tier is pallas, clay's large composite rides the
     MXU, and the lower tiers route to XLA / numpy."""
-    small = matrix_to_static(np.ones((3, 7), dtype=np.int64))
+    # a DENSE small composite (high-entropy entries: the XOR-density
+    # probe must decline it) rides the packed Pallas kernel; the
+    # all-ones parity matrix is pure XOR and rides the scheduled tier
+    # (ISSUE 12)
+    small = matrix_to_static(
+        np.random.default_rng(5).integers(100, 256, (3, 7)))
+    ones = matrix_to_static(np.ones((3, 7), dtype=np.int64))
     big = tuple(tuple(1 for _ in range(704)) for _ in range(64))
     assert sum(v != 0 for row in big for v in row) >= MXU_MATRIX_MIN
     shape_packed = (4, 7, 4, 128)
     # pallas tier
     assert select_matrix_engine(shape_packed, small, 8, packed=True,
                                 engine="pallas") == "pallas"
+    # XOR-scheduled tier: selected on BOTH device tiers (Pallas
+    # backend and the XLA fallback) when the schedule wins the cost
+    # model; never on the numpy tier
+    assert select_matrix_engine(shape_packed, ones, 8, packed=True,
+                                engine="pallas") == "xor"
+    assert select_matrix_engine((4, 7, 2048), ones, 8,
+                                engine="xla") == "xor"
+    assert select_matrix_engine(shape_packed, ones, 8, packed=True,
+                                engine="numpy") == "numpy"
     assert select_matrix_engine((4, 704, 4, 128), big, 8, packed=True,
                                 engine="pallas") == "mxu"
     assert select_matrix_engine((4, 704, 2048), big, 8,
@@ -177,17 +192,20 @@ def test_engine_selection_table():
 def test_plugins_route_composites_to_pallas():
     """Engine-selection assertion of the acceptance criterion: the
     composite matrices shec and clay ACTUALLY build route to a device
-    kernel (Pallas for shec's plan, MXU for clay's big composite) on a
-    Pallas-tier backend, for the bench shapes."""
+    kernel (the XOR-scheduled tier for shec's pure-XOR single-erasure
+    plan — ISSUE 12; MXU for clay's big composite) on a Pallas-tier
+    backend, for the bench shapes."""
     shec = _factory("shec", {"k": "6", "m": "3", "c": "2"})
     n = shec.get_chunk_count()
     avail = tuple(i for i in range(n) if i != 1)
     plan = shec.tcache.get_plan(shec.matrix, shec.k, shec.w,
                                 frozenset(avail), frozenset((1,)))
     _, ms, _ = shec._plan_static(plan)
-    # bench shape: 128 KiB chunks -> 256 packed rows
+    # bench shape: 128 KiB chunks -> 256 packed rows.  The e=1 plan
+    # matrix is a pure-XOR parity row: the XOR-density probe must
+    # schedule it (the shec decode row's 17.6 -> RS-class story)
     assert select_matrix_engine((32, len(ms[0]), 256, 128), ms, 8,
-                                packed=True, engine="pallas") == "pallas"
+                                packed=True, engine="pallas") == "xor"
 
     clay = _factory("clay", {"k": "8", "m": "4", "d": "11"})
     avail = tuple(i for i in range(1, 12))

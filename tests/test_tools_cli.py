@@ -539,3 +539,31 @@ def test_bench_diff_cli_red_and_green(tmp_path):
     r = subprocess.run([sys.executable, script],
                        capture_output=True, text=True, cwd=REPO_ROOT)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_bench_diff_cli_composite_decode_red(tmp_path):
+    """The composite_decode category (ISSUE 12): a 30% shec decode-row
+    drop trips the sentinel under its own category name even while
+    the headline and the RS decode row hold steady — the gap the
+    XOR-scheduled kernels closed can never silently reopen."""
+    import os
+    script = os.path.join(REPO_ROOT, "tools", "bench_diff.py")
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "bench", "rc": 0, "tail": "", "parsed": {
+            "metric": "m", "value": 100.0, "git_sha": "aaa",
+            "timestamp": "2026-01-01T00:00:00+00:00",
+            "decode_rows": {"rs_k8_m3_e2": 140.0,
+                            "shec_k6_m3_c2_e1": {"gbps": 100.0},
+                            "clay_k8_m4_d11_e1": {"gbps": 50.0}}}}))
+    (tmp_path / "BENCH_LAST_GOOD.json").write_text(json.dumps(
+        {"metric": "m", "value": 100.0, "git_sha": "bbb",
+         "timestamp": "2026-02-01T00:00:00+00:00",
+         "decode_rows": {"rs_k8_m3_e2": {"gbps": 140.0},
+                         "shec_k6_m3_c2_e1": {"gbps": 70.0},
+                         "clay_k8_m4_d11_e1": {"gbps": 50.0}}}))
+    r = subprocess.run([sys.executable, script, "--repo",
+                        str(tmp_path)],
+                       capture_output=True, text=True, cwd=REPO_ROOT)
+    assert r.returncode == 4, r.stdout
+    assert "composite_decode:shec_k6_m3_c2_e1" in r.stderr
+    assert "rs_k8_m3_e2" not in r.stderr

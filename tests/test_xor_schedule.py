@@ -1,0 +1,474 @@
+"""XOR-scheduled composite kernels (ISSUE 12, ops/xor_schedule.py +
+ops/pallas_gf.py kernel family).
+
+Pins, per the issue's test satellite:
+- seeded fuzz (>= 100 matrices across shec/clay/lrc patterns plus
+  adversarial dense/identity/singleton/zero cases) holding scheduled
+  byte-identity against the regionops ground truth on all three
+  tiers: the numpy executor (the host tier runs the IDENTICAL
+  schedule), the XLA build, and the interpret-mode Pallas kernels
+  (byte + packed layouts);
+- the property that the scheduler's XOR-op count never exceeds the
+  naive bit-matrix expansion (greedy CSE only folds pairs with
+  co-occurrence >= 2, so it is monotone by construction);
+- engine-selection routing: the XOR-density probe schedules sparse/
+  XOR-heavy matrices on both device tiers, declines dense ones, and
+  never overrides the numpy tier;
+- the host-analytic acceptance gate: every shec/clay/lrc single-
+  erasure pattern models within the ratcheted envelope of the RS
+  decode reference (bench/non_regression.py::composite_decode_guard);
+- bench decode rows carry engine + xor_schedule provenance
+  (metric_version 9).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+from ceph_tpu.ops import regionops
+from ceph_tpu.ops import xor_schedule as xs
+from ceph_tpu.ops.xla_ops import bitmatrix_to_static, matrix_to_static
+
+
+def _factory(plugin, profile):
+    return ErasureCodePluginRegistry.instance().factory(plugin,
+                                                        dict(profile))
+
+
+def _ground_truth(data: np.ndarray, ms) -> np.ndarray:
+    return regionops.matrix_encode(data, np.array(ms, dtype=np.int64), 8)
+
+
+# ----------------------------------------------------------------------
+# the fuzz corpus: adversarial fixed cases + seeded random families +
+# the real plugin pattern matrices
+
+def _plugin_matrices():
+    mats = []
+    shec = _factory("shec", {"k": "6", "m": "3", "c": "2"})
+    n = shec.get_chunk_count()
+    for e in range(n):
+        avail = frozenset(i for i in range(n) if i != e)
+        plan = shec.tcache.get_plan(shec.matrix, shec.k, shec.w,
+                                    avail, frozenset({e}))
+        mats.append(shec._plan_static(plan)[1])
+    mats.append(matrix_to_static(shec.matrix))
+    lrc = _factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = lrc.get_chunk_count()
+    for e in range(n):
+        avail = tuple(i for i in range(n) if i != e)
+        mats.append(lrc._decode_composite(avail, (e,))[1])
+    mats.append(lrc._decode_composite(tuple(range(2, n)), (0, 1))[1])
+    clay = _factory("clay", {"k": "4", "m": "2", "d": "5"})
+    n = clay.k + clay.m
+    for e in range(3):
+        avail = tuple(i for i in range(n) if i != e)
+        mats.append(clay._decode_composite(avail, (e,))[1])
+    mats.append(clay._encode_composite()[1])
+    return mats
+
+
+def _fuzz_matrices(n_random: int = 84, seed: int = 1234):
+    """Deterministic corpus: fixed adversarial cases, seeded random
+    families, and the real plugin composites (>= 100 total)."""
+    fixed = [
+        matrix_to_static(np.eye(4, dtype=np.int64)),          # identity
+        matrix_to_static(np.zeros((2, 3), dtype=np.int64)),   # all-zero
+        ((7,),),                                              # singleton
+        matrix_to_static(np.ones((3, 7), dtype=np.int64)),    # parity
+        ((1, 1, 1, 1, 1, 1, 1), (1, 2, 4, 8, 16, 32, 64)),    # ring
+        ((0, 0, 0), (1, 0, 2), (0, 0, 0)),                    # zero rows
+        ((255, 255), (255, 255)),                             # max entry
+        ((1, 0, 0, 0), (1, 0, 0, 0)),                         # dup rows
+    ]
+    rng = np.random.default_rng(seed)
+    out = list(fixed)
+    kinds = ("dense", "sparse", "monomial", "binary", "window",
+             "duprows")
+    for i in range(n_random):
+        r = int(rng.integers(1, 6))
+        s = int(rng.integers(1, 11))
+        kind = kinds[i % len(kinds)]
+        if kind == "dense":
+            M = rng.integers(0, 256, (r, s))
+        elif kind == "sparse":
+            M = rng.integers(0, 256, (r, s)) \
+                * (rng.random((r, s)) < 0.3)
+        elif kind == "monomial":
+            M = (1 << rng.integers(0, 8, (r, s))) \
+                * (rng.random((r, s)) < 0.7)
+        elif kind == "binary":
+            M = rng.integers(0, 2, (r, s))
+        elif kind == "window":
+            M = np.zeros((r, s), dtype=np.int64)
+            for ri in range(r):
+                start = int(rng.integers(0, s))
+                width = int(rng.integers(1, s + 1))
+                for t in range(width):
+                    M[ri, (start + t) % s] = int(rng.integers(1, 256))
+        else:  # duprows: near-identical rows (CSE-heavy)
+            base = rng.integers(0, 256, s)
+            M = np.stack([base ^ rng.integers(0, 2, s)
+                          for _ in range(r)])
+        out.append(matrix_to_static(M.astype(np.int64)))
+    out.extend(_plugin_matrices())
+    return out
+
+
+def test_fuzz_schedule_property_and_three_tier_identity():
+    """>= 100 matrices: (a) the scheduler's XOR-op count never
+    exceeds the naive bit-matrix expansion; (b) the numpy executor —
+    the IDENTICAL schedule the device kernels run — is byte-identical
+    to the regionops ground truth for every matrix; (c) the XLA build
+    and both interpret-mode Pallas kernels agree on rotating
+    subsets."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.pallas_gf import (apply_matrix_xor_pallas,
+                                        apply_matrix_xor_packed,
+                                        apply_matrix_xor_xla,
+                                        pack_chunks, unpack_chunks)
+
+    mats = _fuzz_matrices()
+    assert len(mats) >= 100
+    rng = np.random.default_rng(99)
+    for i, ms in enumerate(mats):
+        sched = xs.build_schedule(ms)
+        assert sched.xor_ops <= sched.naive_xor_ops, (i, ms)
+        s = len(ms[0])
+        data = rng.integers(0, 256, (2, s, 512), dtype=np.uint8)
+        ref = _ground_truth(data, ms)
+        got = xs.apply_schedule_numpy(data, sched)
+        assert np.array_equal(got, ref), (i, sched.transform)
+        if i % 5 == 0:
+            got = np.asarray(apply_matrix_xor_xla(jnp.asarray(data),
+                                                  sched.static))
+            assert np.array_equal(got, ref), (i, "xla")
+        if i % 23 == 0:
+            got = np.asarray(apply_matrix_xor_pallas(
+                jnp.asarray(data), sched.static, True))
+            assert np.array_equal(got, ref), (i, "pallas")
+            pk = jnp.asarray(pack_chunks(data))
+            got = unpack_chunks(np.asarray(apply_matrix_xor_packed(
+                pk, sched.static, True)))
+            assert np.array_equal(got, ref), (i, "pallas-packed")
+
+
+def test_schedule_degenerate_cases():
+    """Identity rows are zero-op copies, zero rows are -1 (all-zero)
+    outputs, and the singleton matrix schedules correctly."""
+    ident = xs.build_schedule(matrix_to_static(np.eye(3,
+                                                      dtype=np.int64)))
+    assert ident.vpu_ops == 0 and ident.static[4] == (0, 1, 2)
+    zeros = xs.build_schedule(matrix_to_static(np.zeros(
+        (2, 2), dtype=np.int64)))
+    assert zeros.static[4] == (-1, -1)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (1, 2, 256), dtype=np.uint8)
+    out = xs.apply_schedule_numpy(data, zeros)
+    assert not out.any()
+    single = xs.build_schedule(((7,),))
+    got = xs.apply_schedule_numpy(data[:, :1], single)
+    assert np.array_equal(got, _ground_truth(data[:, :1], ((7,),)))
+
+
+def test_ring_transform_selected_and_exact():
+    """A monomial (power-of-x) matrix takes the polynomial-ring
+    schedule (arxiv 1701.07731): shift pairs + one feedback fold per
+    output row, cheaper than the CSE form, byte-identical."""
+    ms = ((1, 1, 1, 1, 1, 1, 1), (1, 2, 4, 8, 16, 32, 64))
+    sched = xs.build_schedule(ms)
+    assert sched.transform == "ring"
+    kinds = {op[0] for op in sched.static[3]}
+    assert "shl" in kinds and "shr" in kinds and "xt" not in kinds
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (3, 7, 1024), dtype=np.uint8)
+    assert np.array_equal(xs.apply_schedule_numpy(data, sched),
+                          _ground_truth(data, ms))
+    # and the probe PREFERS it over the dense kernel
+    assert xs.preferred_schedule(ms, 8) is not None
+
+
+def test_determinism():
+    """Same matrix -> identical schedule, every time (the PatternCache
+    contract: a key always maps to the same value)."""
+    ms = _plugin_matrices()[0]
+    a = xs.build_schedule(ms)
+    xs.probe_schedule.cache_clear()
+    b = xs.build_schedule(ms)
+    assert a.static == b.static and a.stats() == b.stats()
+
+
+def test_selection_routing():
+    """The XOR-density probe's routing: pure-XOR parity schedules on
+    both device tiers, dense matrices decline, huge matrices stay on
+    the MXU, and the numpy tier is never overridden."""
+    from ceph_tpu.ops.pallas_gf import MXU_MATRIX_MIN, \
+        select_matrix_engine
+
+    ones = matrix_to_static(np.ones((3, 7), dtype=np.int64))
+    dense = matrix_to_static(
+        np.random.default_rng(5).integers(100, 256, (3, 7)))
+    assert select_matrix_engine((2, 7, 2048), ones, 8,
+                                engine="pallas") == "xor"
+    assert select_matrix_engine((2, 7, 2048), ones, 8,
+                                engine="xla") == "xor"
+    assert select_matrix_engine((2, 7, 4, 128), ones, 8, packed=True,
+                                engine="pallas") == "xor"
+    assert select_matrix_engine((2, 7, 2048), dense, 8,
+                                engine="pallas") == "pallas"
+    assert select_matrix_engine((2, 7, 2048), ones, 8,
+                                engine="numpy") == "numpy"
+    # lane-ragged chunks that only the XLA build supports still
+    # schedule (the runner picks the XLA build under use_pallas)
+    assert select_matrix_engine((2, 7, 1004), ones, 8,
+                                engine="pallas") == "xor"
+    # the clay-big all-ones composite exceeds the scheduling budget
+    # and stays on the MXU
+    big = tuple(tuple(1 for _ in range(704)) for _ in range(64))
+    assert sum(v != 0 for row in big for v in row) >= MXU_MATRIX_MIN
+    assert select_matrix_engine((4, 704, 2048), big, 8,
+                                engine="pallas") == "mxu"
+
+
+def test_dispatch_through_best_matches_groundtruth():
+    """apply_matrix_best / apply_matrix_packed_best route the
+    scheduled tier end to end, byte-identical to the ground truth."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.pallas_gf import (apply_matrix_best,
+                                        apply_matrix_packed_best,
+                                        pack_chunks, unpack_chunks,
+                                        select_matrix_engine)
+
+    ones = matrix_to_static(np.ones((3, 7), dtype=np.int64))
+    assert select_matrix_engine((2, 7, 2048), ones, 8) in ("xor",
+                                                           "numpy")
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (2, 7, 2048), dtype=np.uint8)
+    ref = _ground_truth(data, ones)
+    got = np.asarray(apply_matrix_best(jnp.asarray(data), ones, 8))
+    assert np.array_equal(got, ref)
+    pk = jnp.asarray(pack_chunks(data))
+    got = unpack_chunks(np.asarray(apply_matrix_packed_best(pk, ones)))
+    assert np.array_equal(got, ref)
+
+
+def test_host_tier_runs_identical_schedule():
+    """host_matrix_apply: the numpy tier executes the same schedule
+    (when preferred) or the regionops ground truth — byte-identical
+    either way, for scheduled and unscheduled matrices alike."""
+    rng = np.random.default_rng(21)
+    for M in (np.ones((3, 7), dtype=np.int64),
+              rng.integers(0, 256, (3, 7))):
+        ms = matrix_to_static(M)
+        data = rng.integers(0, 256, (2, 7, 1024), dtype=np.uint8)
+        got = xs.host_matrix_apply(data, M, ms, 8)
+        assert np.array_equal(got, _ground_truth(data, ms))
+
+
+def test_shec_decode_surfaces_scheduled_byte_identity():
+    """shec single-data-erasure decode — the pattern the XOR tier now
+    owns — stays byte-identical across the host batch surface, the
+    device surface and the packed surface."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.pallas_gf import pack_chunks, unpack_chunks
+
+    shec = _factory("shec", {"k": "6", "m": "3", "c": "2"})
+    n = shec.get_chunk_count()
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, (2, 6, 2048), dtype=np.uint8)
+    par = np.asarray(shec.encode_chunks_batch(data))
+    allc = np.concatenate([data, par], axis=1)
+    for e in (1, 3):
+        avail = tuple(i for i in range(n) if i != e)
+        surv = np.ascontiguousarray(allc[:, list(avail)])
+        ref = np.asarray(shec.decode_chunks_batch(surv, avail, (e,)))
+        assert np.array_equal(ref, data[:, e:e + 1])
+        got = np.asarray(shec.decode_chunks_jax(jnp.asarray(surv),
+                                                avail, (e,)))
+        assert np.array_equal(got, ref), e
+        gp = unpack_chunks(np.asarray(shec.decode_chunks_packed_jax(
+            jnp.asarray(pack_chunks(surv)), avail, (e,))))
+        assert np.array_equal(gp, ref), e
+
+
+def test_bitmatrix_schedule_paths():
+    """Packet-layout CSE: scheduled bitmatrix kernels (Pallas
+    interpret + XLA build) agree with the ground truth and the plain
+    kernel; the probe declines when sharing does not pay."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.pallas_gf import (apply_bitmatrix_best,
+                                        apply_bitmatrix_xor_pallas,
+                                        apply_bitmatrix_xor_xla)
+
+    ec = _factory("jerasure", {"technique": "cauchy_orig", "k": "4",
+                               "m": "2", "packetsize": "512"})
+    rows = bitmatrix_to_static(ec.bitmatrix)
+    sched = xs.probe_bitmatrix_schedule(rows, ec.w)
+    assert sched is not None
+    assert sched.xor_ops < sched.naive_xor_ops
+    rng = np.random.default_rng(41)
+    data = rng.integers(0, 256, (2, 4, ec.w * 512 * 2), dtype=np.uint8)
+    ref = regionops.bitmatrix_encode(data, ec.bitmatrix, ec.w, 512)
+    got = np.asarray(apply_bitmatrix_xor_xla(jnp.asarray(data),
+                                             sched.static, ec.w, 512))
+    assert np.array_equal(got, ref)
+    got = np.asarray(apply_bitmatrix_xor_pallas(
+        jnp.asarray(data), sched.static, ec.w, 512, True))
+    assert np.array_equal(got, ref)
+    got = np.asarray(apply_bitmatrix_best(jnp.asarray(data), rows,
+                                          ec.w, 512))
+    assert np.array_equal(got, ref)
+
+
+def test_composite_decode_guard_green():
+    """The ratcheted host-analytic acceptance gate: every shec/lrc
+    and clay-small single-erasure pattern models within the envelope
+    (bench/non_regression.py; the corpus check runs the full set
+    including clay k=8,m=4,d=11)."""
+    from ceph_tpu.bench.non_regression import composite_decode_guard
+
+    for plugin, prof in (("shec", {"k": "6", "m": "3", "c": "2"}),
+                         ("shec", {"k": "4", "m": "3", "c": "2"}),
+                         ("lrc", {"k": "4", "m": "2", "l": "3"}),
+                         ("clay", {"k": "4", "m": "2", "d": "5"})):
+        ec = _factory(plugin, prof)
+        errors = composite_decode_guard("guard", plugin, ec)
+        assert errors == [], (plugin, errors)
+
+
+def test_guard_red_on_broken_scheduler(monkeypatch):
+    """The guard fails LOUDLY when the scheduler stops scheduling —
+    the 'gap silently reopens' regression it exists for."""
+    from ceph_tpu.bench import non_regression as nr
+
+    monkeypatch.setattr(nr, "composite_decode_guard",
+                        nr.composite_decode_guard)  # anchor import
+    ec = _factory("shec", {"k": "6", "m": "3", "c": "2"})
+    import ceph_tpu.ops.xor_schedule as xsmod
+    monkeypatch.setattr(xsmod, "preferred_schedule",
+                        lambda *a, **k: None)
+    errors = nr.composite_decode_guard("guard", "shec", ec)
+    assert errors and "XOR scheduler regression" in errors[0]
+
+
+def test_analytic_xor_cost_model():
+    """The analytic model extended to XOR schedules: flops carry the
+    schedule's real op count; the HBM side matches the dense model."""
+    from ceph_tpu.telemetry.profiler import (analytic_matrix_cost,
+                                             analytic_xor_schedule_cost)
+
+    dense = analytic_matrix_cost(4, 3, 8, 4096)
+    sched = analytic_xor_schedule_cost(4, 3, 8, 4096, vpu_ops=6)
+    assert sched["bytes accessed"] == dense["bytes accessed"]
+    assert sched["flops"] == 4 * 6 * 4096
+    assert sched["flops"] < dense["flops"]
+
+
+def test_bench_decode_rows_carry_engine_and_schedule():
+    """metric_version 9: the decode workload result carries engine +
+    xor_schedule provenance; --device host pins engine=numpy without
+    touching jax device init."""
+    from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+
+    bench = ErasureCodeBench()
+    bench.setup(["-p", "shec", "-P", "k=4", "-P", "m=3", "-P", "c=2",
+                 "--workload", "decode", "--erased", "1",
+                 "--device", "host", "--batch", "2", "-s", "8192",
+                 "--iterations", "1"])
+    res = bench.run()
+    assert res["engine"] == "numpy"
+    assert res["xor_schedule"] is not None
+    stats = res["xor_schedule"]
+    for f in ("len", "xor_ops", "dense_gf_ops", "reduction_ratio",
+              "transform"):
+        assert f in stats, f
+    assert stats["xor_ops"] <= stats["naive_xor_ops"]
+
+    bench = ErasureCodeBench()
+    bench.setup(["-p", "jerasure", "-P", "technique=reed_sol_van",
+                 "-P", "k=4", "-P", "m=2", "--workload", "decode",
+                 "--erased", "1", "--device", "jax", "--batch", "2",
+                 "-s", "8192", "--iterations", "1"])
+    res = bench.run()
+    assert res["engine"] in ("xor", "xla", "pallas", "mxu")
+
+
+def test_bench_profile_host_rows_use_xor_model():
+    """--workload profile --device host: a scheduled decode pattern's
+    attribution row carries engine=xor and the schedule's reduced
+    flops (the analytic model extended to XOR schedules)."""
+    from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+    from ceph_tpu.telemetry.profiler import analytic_matrix_cost
+
+    bench = ErasureCodeBench()
+    bench.setup(["-p", "shec", "-P", "k=4", "-P", "m=3", "-P", "c=2",
+                 "--workload", "profile", "--erased", "1",
+                 "--device", "host", "--batch", "2", "-s", "8192",
+                 "--iterations", "1"])
+    res = bench.run()
+    rows = {r["kind"]: r for r in res["profile_rows"]}
+    dec = rows["host-decode"]
+    assert dec["engine"] == "xor"
+    # the scheduled flops undercut the dense model for the same dims
+    chunk = 8192 // 4  # k=4 -> chunk size of an 8 KiB object
+    dense = analytic_matrix_cost(2, 1, 3, chunk)["flops"]
+    assert dec["flops"] < dense
+
+
+def test_bench_diff_composite_decode_category(tmp_path):
+    """bench_diff's composite_decode category: shec/clay decode rows
+    renormalize out of the generic decode category, get their own
+    noise floor, and a 40% shec drop regresses (red fixture) while
+    the RS row stays in `decode`."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "bench_diff.py"))
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+
+    rec_old = {"value": 100.0, "git_sha": "aaa", "timestamp": "t1",
+               "decode_rows": {"rs_k8_m3_e2": 140.0,
+                               "shec_k6_m3_c2_e1": {"gbps": 100.0},
+                               "clay_k8_m4_d11_e1": {"gbps": 50.0}}}
+    series = bd.extract_series(rec_old)
+    assert "decode:rs_k8_m3_e2" in series
+    assert series["composite_decode:shec_k6_m3_c2_e1"] == 100.0
+    assert series["composite_decode:clay_k8_m4_d11_e1"] == 50.0
+    assert "decode:shec_k6_m3_c2_e1" not in series
+    assert "composite_decode" in bd.FLOORS
+
+    rec_new = {"value": 100.0, "git_sha": "bbb", "timestamp": "t2",
+               "decode_rows": {"rs_k8_m3_e2": 140.0,
+                               "shec_k6_m3_c2_e1": {"gbps": 60.0},
+                               "clay_k8_m4_d11_e1": {"gbps": 50.0}}}
+    report = bd.diff([("r1", rec_old)], "cand", rec_new, bd.FLOORS)
+    assert not report["ok"]
+    assert report["regressions"] == \
+        ["composite_decode:shec_k6_m3_c2_e1"]
+
+
+@pytest.mark.slow
+def test_clay_big_composite_stays_in_budget():
+    """clay k=8,m=4,d=11: the 64x704 composite exceeds the scheduling
+    budget (probe None — it stays on the MXU/dense tiers) but its
+    dense sparse-aware model still sits inside the guard envelope;
+    the probe itself must stay fast."""
+    import time
+
+    from ceph_tpu.bench.non_regression import composite_decode_guard
+
+    ec = _factory("clay", {"k": "8", "m": "4", "d": "11"})
+    avail = tuple(range(1, 12))
+    _, ms = ec._decode_composite(avail, (0,))
+    t0 = time.perf_counter()
+    assert xs.probe_schedule(ms, 8) is None
+    assert time.perf_counter() - t0 < 5.0
+    assert composite_decode_guard("guard", "clay", ec) == []

@@ -12,7 +12,10 @@ makes it a gate:
    ``last_good`` record, deduped by (git_sha, timestamp), so a
    tunnel-down round never reads as a 100% regression).
 2. **Normalize** to named higher-is-better series: ``headline`` (the
-   carry-chain encode GB/s), ``decode:<row>``, ``degraded:<row>``,
+   carry-chain encode GB/s), ``decode:<row>``,
+   ``composite_decode:<row>`` (the shec/clay decode rows — the gap
+   ISSUE 12's XOR-scheduled kernels close gets its own category and
+   noise floor, so it can never silently reopen), ``degraded:<row>``,
    ``serving:<row>`` (GB/s-under-SLO), ``multichip:<row>``,
    ``scenario:<row>`` (GB/s-under-SLO *under contention* — the
    p99-under-contention gate of ISSUE 11), ``profile:<row>``.
@@ -52,6 +55,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FLOORS: Dict[str, float] = {
     "headline": 0.15,
     "decode": 0.20,
+    # the shec/clay composite-decode rows (ISSUE 12): device-chained
+    # like the RS decode row, so they share its tight floor — a
+    # reopened composite gap must trip the sentinel, not hide in a
+    # generic category
+    "composite_decode": 0.20,
     "multichip": 0.25,
     "degraded": 0.45,
     "serving": 0.45,
@@ -95,7 +103,14 @@ def extract_series(rec: dict) -> Dict[str, float]:
         for name, row in sorted(body.items()):
             g = _gbps(row)
             if g is not None and g > 0:
-                series[f"{cat}:{name}"] = g
+                rcat = cat
+                if cat == "decode" and name.startswith(("shec", "clay")):
+                    # the composite-decode gap gets its own category
+                    # (and floor) across the WHOLE trajectory — old
+                    # records renormalize identically, so best-prior
+                    # comparisons stay well-defined
+                    rcat = "composite_decode"
+                series[f"{rcat}:{name}"] = g
     # serving + scenario rows: GB/s-under-SLO is the series (raw
     # gbps as the fallback for rows predating the field)
     for section, cat in (("serving_rows", "serving"),

@@ -14,6 +14,16 @@
 # latency percentiles, docs/OBSERVABILITY.md); consumers that only
 # read `gbps` are unaffected — rows are appended verbatim.
 #
+# Since metric_version 9 (ISSUE 12) the decode rows also carry
+# `engine` (which tier select_matrix_engine routed the pattern's
+# composite matrix to: xor|mxu|pallas|xla) and `xor_schedule` (the
+# XOR scheduler's stats — length, xor_ops vs dense_gf_ops,
+# reduction_ratio, transform — null when the probe declines), so a
+# decode number that moves is self-explaining.  The shec row now
+# rides the XOR-scheduled Pallas kernel (docs/PERF.md "XOR-scheduled
+# composite kernels"); tools/bench_diff.py tracks the shec/clay rows
+# under the dedicated `composite_decode` category.
+#
 # The axon tunnel wedges at times (see bench.py _device_reachable);
 # probe first:
 #   timeout 100 python -c "import jax; print(len(jax.devices()))"
@@ -55,7 +65,7 @@ run_row "north star encode, packed, slice chain (roofline-honest)" \
     -s $((1<<20)) --batch 64 --loop 1024 --layout packed \
     --chain slice --json
 
-run_row "row 3: shec k=6 m=3 c=2 single-chunk decode (unified engine: packed Pallas, slice chain)" \
+run_row "row 3: shec k=6 m=3 c=2 single-chunk decode (XOR-scheduled packed kernel, slice chain)" \
     python -m ceph_tpu.bench.erasure_code_benchmark \
     -p shec -P k=6 -P m=3 -P c=2 -s $((6*131072)) \
     --workload decode -e 1 --batch 32 --loop 256 \
