@@ -110,7 +110,20 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # records WHY a decode number moved; tools/bench_diff.py gains the
 # `composite_decode` category tracking the shec/clay decode rows with
 # its own noise floor.  Consumers reading only `gbps` are unaffected.
-METRIC_VERSION = 9
+# v10 (ISSUE 13, supervised dispatch plane): a `device_chaos_rows`
+# section — batched recovery driven through the supervised
+# fused-repair seam while a seeded DispatchFault script (transient,
+# HBM OOM, persistent backend loss) fires mid-run
+# (--workload device-chaos; ops/supervisor.py + chaos/dispatch.py):
+# the row's GB/s is recovery-under-fault throughput (the bench_diff
+# `device_chaos` category) and it carries the supervisor counter
+# deltas (retries, rung downshifts, demotions, quarantines,
+# re-promotions, host completions).  Every line — success AND
+# tunnel-down error — additionally carries a top-level `supervisor`
+# blob (the process supervisor's cumulative counters + demotion
+# state), so a round artifact shows whether the run survived device
+# faults and on which tier it finished.
+METRIC_VERSION = 10
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -264,6 +277,61 @@ SCENARIO_ROWS = [
       "--size", str(1 << 14), "--requests", "128", "--batch", "4",
       "-e", "1", "--storm-events", "6", "--seed", "42"]),
 ]
+
+# Device-chaos rows (ISSUE 13): batched recovery through the
+# supervised fused-repair seam while a seeded DispatchFault script
+# fires mid-run — transient (bounded retry), HBM OOM (batch-rung
+# downshift), persistent backend loss (live tier demotion, numpy-twin
+# completion, health-probe re-promotion).  Byte-identical heal and
+# zero data loss gate in-workload; the GB/s is the bench_diff
+# `device_chaos` series so recovery-under-fault cannot silently
+# regress.  The tunnel-down error path re-pins --device host
+# (argparse last-wins): the same loop supervises the grouped host
+# repair at a bench seam, so the classification machinery stays
+# measured through an outage.
+DEVICE_CHAOS_ROWS = [
+    ("rs_k8_m3_device_chaos",
+     ["--plugin", "jerasure", "--parameter", "technique=reed_sol_van",
+      "--parameter", "k=8", "--parameter", "m=3",
+      "--size", str(1 << 16), "--workload", "device-chaos",
+      "--device", "jax", "--batch", "8", "--iterations", "2",
+      "-e", "1", "--seed", "42"]),
+]
+
+DEVICE_CHAOS_ROW_FIELDS = ("supervisor", "faults_fired",
+                           "demoted_at_end", "erasures", "verified")
+
+
+def _device_chaos_rows(host_only: bool = False) -> dict:
+    rows = {}
+    for name, argv in DEVICE_CHAOS_ROWS:
+        row_argv = list(argv)
+        if host_only:
+            row_argv += ["--device", "host", "--iterations", "1"]
+        try:
+            res = _run(row_argv)
+            row = _row_result(res)
+            for f in DEVICE_CHAOS_ROW_FIELDS:
+                row[f] = res.get(f)
+            rows[name] = row
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"device-chaos/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
+
+
+def _supervisor_blob() -> dict:
+    """The process supervisor's cumulative counters + demotion state
+    for the one-line artifact (metric_version 10) — present on
+    success AND error lines, so a tunnel-down round records what the
+    supervised plane did about it."""
+    try:
+        from ceph_tpu.ops.supervisor import global_supervisor
+        return global_supervisor().stats()
+    except Exception as e:  # noqa: BLE001 — metadata never kills bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
 
 SCENARIO_ROW_FIELDS = (
     "gbps_under_slo", "deadline_miss_rate", "arbiter_enabled",
@@ -536,7 +604,9 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "cluster_rows": _cluster_rows(host_only=True),
         "profile_rows": _profile_rows(host_only=True),
         "scenario_rows": _scenario_rows(host_only=True, requests=64),
+        "device_chaos_rows": _device_chaos_rows(host_only=True),
         "last_good": _read_last_good(),
+        "supervisor": _supervisor_blob(),
         "telemetry": _telemetry_blob(),
         **_audit_meta(),
     }
@@ -746,11 +816,13 @@ def main() -> int:
         "cluster_rows": _cluster_rows(),
         "profile_rows": _profile_rows(),
         "scenario_rows": _scenario_rows(),
+        "device_chaos_rows": _device_chaos_rows(),
         "lat_p50_ms": best.get("lat_p50_ms"),
         "lat_p99_ms": best.get("lat_p99_ms"),
         "lat_p999_ms": best.get("lat_p999_ms"),
         "vs_host_groundtruth": round(best["gbps"] / host["gbps"], 3)
         if host["gbps"] > 0 else None,
+        "supervisor": _supervisor_blob(),
         "telemetry": _telemetry_blob(),
         **_audit_meta(),
     }

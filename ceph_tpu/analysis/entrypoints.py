@@ -730,6 +730,40 @@ def _build_scenario_runner() -> Built:
     return Built(scenario_selftest, (), scenario_selftest)
 
 
+def _build_supervisor_selftest() -> Built:
+    """The supervised dispatch plane as a host-tier entry (ISSUE 13):
+    the full classification ladder — transient retry, OOM rung split,
+    persistent-loss demotion to the ground-truth twin, corrupt-output
+    self-verify, health-probe re-promotion — on isolated FakeClock
+    state: ZERO jax compiles, zero device arrays, forever.  A
+    recovery plane that itself needed the device would deadlock
+    exactly when the device is what just failed."""
+    from ..ops.supervisor import supervisor_selftest
+
+    return Built(supervisor_selftest, (), supervisor_selftest)
+
+
+def _build_fused_repair_supervised() -> Built:
+    """The supervised fused-repair seam as a jit-tier entry: the SAME
+    cached decode→re-encode program under the supervisor's eager
+    wrapper, on its own erasure pattern so it audits its own cached
+    program.  Tracing must see the raw program only (the wrapper
+    gates on tracer-ness), so supervision adds ZERO primitives and
+    the warm==0 sentinel pins that a supervised clean path never
+    recompiles."""
+    import numpy as np
+
+    from ..codes.engine import fused_repair_call
+
+    ec = representative_instance("jerasure")
+    n = ec.get_chunk_count()
+    erased = (3,)
+    available = tuple(i for i in range(n) if i != 3)
+    fn = fused_repair_call(ec, available, erased)
+    return Built(fn, (np.zeros((B, len(available), C), np.uint8),),
+                 fused_repair_call)
+
+
 def _build_scenario_qos() -> Built:
     """The mClock arbiter as a host-tier entry (ISSUE 11):
     reservation floor, weight pacing, limit ceiling and burn-rate
@@ -865,6 +899,18 @@ def registry() -> Tuple[EntryPoint, ...]:
                    _build_scenario_runner, allow=None, trace_budget=0),
         EntryPoint("scenario.qos", "scenario", "host",
                    _build_scenario_qos, allow=None, trace_budget=0),
+        # the supervised dispatch plane (ISSUE 13): the supervisor is
+        # host control flow forever (0 compiles, 0 device arrays),
+        # and the supervised fused-repair seam's program is the raw
+        # cached program — the wrapper is invisible to tracing, so a
+        # primitive appearing here that the unsupervised entry lacks
+        # would mean supervision leaked into the jaxpr
+        EntryPoint("ops.supervisor", "ops", "host",
+                   _build_supervisor_selftest, allow=None,
+                   trace_budget=0),
+        EntryPoint("engine.fused_repair_supervised", "engine", "jit",
+                   _build_fused_repair_supervised, allow=GF_XLA_PRIMS,
+                   trace_budget=16),
     ]
     return tuple(entries)
 

@@ -133,7 +133,8 @@ class ErasureCodeBench:
                         choices=["encode", "decode", "degraded",
                                  "repair-batched", "recovery-churn",
                                  "serving", "multichip", "cluster",
-                                 "profile", "scenario"])
+                                 "profile", "scenario",
+                                 "device-chaos"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -1392,6 +1393,156 @@ class ErasureCodeBench:
         res["profile_rows"] = rows
         return res
 
+    # -- device-chaos (the supervised dispatch plane under injected
+    # device-plane faults: recovery-under-fault throughput — ISSUE 13,
+    # ops/supervisor.py + chaos/dispatch.py) ----------------------------
+
+    def device_chaos(self) -> dict:
+        """Recovery throughput while the device plane FAILS mid-run:
+        --batch objects of --size logical bytes, --erasures faults
+        each, repaired through the batched fused-repair seam while a
+        seeded DispatchFault script (transient error, HBM OOM, then a
+        persistent backend loss) fires at the seam's Nth calls.  The
+        supervisor must retry, split the rung, demote the tier live
+        and complete on the numpy twin — byte-identical heal and zero
+        data loss are gated in-workload, and the row carries the
+        supervisor counter deltas so bench_diff's ``device_chaos``
+        category can never silently regress recovery-under-fault.
+
+        ``--device host`` (the tunnel-down error path): the same loop
+        wraps the grouped host repair in the supervisor at a bench
+        seam, so the classification machinery (retry, demoted
+        completion) is still measured without touching a wedged
+        device."""
+        from ..chaos import BitFlip, ShardErasure, inject
+        from ..chaos.dispatch import (DispatchFault, DispatchFaultPlan,
+                                      arm_plan)
+        from ..codes.stripe import HashInfo, StripeInfo
+        from ..codes.stripe import encode as stripe_encode
+        from ..ops.supervisor import global_supervisor
+        from ..recovery.orchestrator import healed
+        from ..scrub import repair_batched
+        a = self.args
+        ec = self._instance()
+        n = ec.get_chunk_count()
+        k = ec.get_data_chunk_count()
+        if a.erasures < 1 or a.erasures + a.corruptions >= n:
+            raise ValueError("device-chaos needs 1 <= erasures + "
+                             "corruptions < n")
+        chunk_size = ec.get_chunk_size(a.size)
+        width = k * chunk_size
+        sinfo = StripeInfo(k, width)
+        rng = np.random.default_rng(a.seed)
+        objects = []
+        for i in range(a.batch):
+            obj = rng.integers(0, 256, size=width,
+                               dtype=np.uint8).tobytes()
+            shards = stripe_encode(sinfo, ec, obj)
+            hinfo = HashInfo(n)
+            hinfo.append(0, shards)
+            objects.append((shards, hinfo))
+        hinfos = [h for _, h in objects]
+        originals = [s for s, _ in objects]
+
+        prng = np.random.default_rng(a.seed + 1)
+        n_pat = max(1, min(4, a.batch))
+        pool = []
+        for _ in range(n_pat):
+            victims = prng.choice(n, size=a.erasures + a.corruptions,
+                                  replace=False)
+            pool.append(([int(v) for v in victims[:a.erasures]],
+                         [int(v) for v in victims[a.erasures:]]))
+
+        def make_stores():
+            stores = []
+            for i, (shards, _) in enumerate(objects):
+                erased, flipped = pool[i % n_pat]
+                inj = []
+                if erased:
+                    inj.append(ShardErasure(shards=list(erased)))
+                if flipped:
+                    inj.append(BitFlip(shards=list(flipped), flips=1))
+                st, _ = inject(shards, inj, seed=a.seed + i,
+                               chunk_size=sinfo.chunk_size)
+                stores.append(st)
+            return stores
+
+        dev = a.device != "host"
+        sup = global_supervisor()
+        seam = ("engine.fused_repair" if dev
+                else "bench.device_chaos")
+
+        def fault_script():
+            # the seeded production-day failure script: a flaky call,
+            # an HBM OOM (device mode — the host seam fires once per
+            # repair pass, so its script compresses to retry + loss),
+            # then the backend dies for two calls
+            if dev:
+                faults = [DispatchFault("transient", seam=seam, at=2,
+                                        calls=1),
+                          DispatchFault("oom", seam=seam, at=3,
+                                        calls=1),
+                          DispatchFault("backend_loss", seam=seam,
+                                        at=5, calls=2)]
+            else:
+                faults = [DispatchFault("transient", seam=seam, at=1,
+                                        calls=1),
+                          DispatchFault("backend_loss", seam=seam,
+                                        at=2, calls=2)]
+            return DispatchFaultPlan(faults, seed=a.seed)
+
+        def run_once():
+            stores = make_stores()
+            if dev:
+                rep = repair_batched(sinfo, ec, stores, hinfos,
+                                     device=True)
+            else:
+                call = (lambda: repair_batched(
+                    sinfo, ec, stores, hinfos, device=False))
+                rep = sup.dispatch(seam, lambda: call(), (),
+                                   host_fn=lambda: call(),
+                                   splittable=False)
+            if not healed(stores, originals):
+                raise RuntimeError("device-chaos: data loss under "
+                                   "injected dispatch faults")
+            return rep
+
+        # warm pattern caches + traces with NO faults armed
+        run_once()
+        before = {key: v for key, v in sup.stats().items()
+                  if isinstance(v, int)}
+        lat = _LatTimer()
+        plans = []
+        begin = time.perf_counter()
+        for _ in range(a.iterations):
+            plan = fault_script()
+            prev = arm_plan(plan)
+            try:
+                lat.run(run_once)
+                plan.clear()
+                # drive the health probe to re-promotion so every
+                # iteration starts from the healthy tier
+                for _ in range(sup.promote_after + 2):
+                    sup.tick()
+            finally:
+                arm_plan(prev)
+            plans.append(plan.summary())
+        elapsed = time.perf_counter() - begin
+        after = sup.stats()
+        res = self._result("device-chaos", elapsed,
+                           width * a.batch * a.iterations, lat)
+        res["erasures"] = a.erasures
+        res["supervisor"] = {
+            key: after[key] - before.get(key, 0)
+            for key in ("retries", "rung_downshifts", "demotions",
+                        "quarantines", "repromotions",
+                        "host_completions", "hangs",
+                        "verify_failures")}
+        res["faults_fired"] = sum(p["fired"] for p in plans)
+        res["demoted_at_end"] = after["demoted"]
+        res["verified"] = True
+        return res
+
     def _run_workload(self) -> dict:
         if self.args.workload == "encode":
             return self.encode()
@@ -1411,6 +1562,8 @@ class ErasureCodeBench:
             return self.profile_workload()
         if self.args.workload == "scenario":
             return self.scenario_workload()
+        if self.args.workload == "device-chaos":
+            return self.device_chaos()
         return self.decode()
 
 

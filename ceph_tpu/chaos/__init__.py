@@ -5,7 +5,10 @@ bit-flips, truncation, stripe zeroing, torn write-backs) and the read
 path (transient backend errors), over an ObjectStore-like ShardStore —
 plus the orchestrator-level adversaries (named crash sites, seeded
 OSDMap churn through epoch-ordered incrementals) the recovery
-orchestrator must survive.  The scrub pipeline (ceph_tpu.scrub), the
+orchestrator must survive, and the device-plane DispatchFault family
+(chaos/dispatch.py: transient/OOM/backend-loss/hang/corrupt armed per
+(seam, Nth call)) the supervised dispatch plane (ops/supervisor.py)
+must classify and absorb.  The scrub pipeline (ceph_tpu.scrub), the
 recovery orchestrator (ceph_tpu.recovery), the fuzz/torture suites,
 the degraded benchmark rows and tools/{scrub,recovery}_demo.py all
 drive the same adversaries, so every robustness claim replays from a
@@ -18,6 +21,12 @@ from .adversaries import (  # noqa: F401
     InjectedCrash,
     MapChurn,
     Straggler,
+)
+from .dispatch import (  # noqa: F401
+    DISPATCH_FAULT_KINDS,
+    DispatchFault,
+    DispatchFaultPlan,
+    dispatch_faults,
 )
 from .injectors import (  # noqa: F401
     BitFlip,

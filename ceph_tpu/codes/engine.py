@@ -32,6 +32,15 @@ repair plan.  Two pieces fix that:
 Engine selection for the matrix applies themselves lives in
 ops/pallas_gf.py::select_matrix_engine (the Pallas→XLA→numpy table,
 documented in docs/PERF.md); this module is the layer above it.
+
+Every eager dispatch through the cached programs routes through the
+supervised dispatch plane (ops/supervisor.py): transient errors
+retry, RESOURCE_EXHAUSTED splits the batch rung, persistent backend
+loss demotes the fallback tier live (the numpy ground-truth twin
+completes the dispatch byte-identically), and mesh-member failure
+quarantines a device and rebuilds the sharded program on the shrunk
+plane.  Traced calls bypass supervision entirely, so jitted programs
+stay supervision-free by construction (the audit entries pin it).
 """
 
 from __future__ import annotations
@@ -293,6 +302,25 @@ def fused_repair_call(ec, available: Tuple[int, ...],
 
         fn = (jax.jit(raw) if plane is None
               else _shard_program(raw, plane, n_out=2))
+
+        # the supervised-dispatch couplings (ops/supervisor.py): the
+        # numpy ground-truth twin (byte-identical by construction —
+        # serve/batcher.py::_host_repair mirrors this exact column
+        # assembly) and the rebuild hook that re-derives the RAW
+        # program after a live tier demotion / plane reshrink (the
+        # pattern cache was cleared, so the rebuilt program lands on
+        # the demoted tier or the shrunk plane)
+        def host_twin(stack):
+            import numpy as np
+
+            from ..serve.batcher import _host_repair
+            return _host_repair(ec, np.asarray(stack), available,
+                                erased)
+
+        def rebuild():
+            return fused_repair_call(ec, available, erased,
+                                     mesh=mesh)._raw
+
         ndev = plane.n_devices if plane is not None else 1
         # the PatternCache key IS the program identity (class +
         # profile + kind + pattern + mesh) — reuse it so two profiles
@@ -334,8 +362,14 @@ def fused_repair_call(ec, available: Tuple[int, ...],
                     "engine_fused_repair_dispatch",
                     eager=eager, plugin=type(ec).__name__), \
                     prof.timed(pk, eager=eager):
-                return fn(stack)
+                if not eager:
+                    return fn(stack)
+                from ..ops.supervisor import global_supervisor
+                return global_supervisor().dispatch(
+                    "engine.fused_repair", fn, (stack,),
+                    host_fn=host_twin, rebuild=rebuild)
 
+        timed._raw = fn
         return timed
 
     return global_pattern_cache().get_or_build(key, build)
@@ -391,6 +425,23 @@ def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
 
         fn = (jax.jit(raw) if plane is None
               else _shard_program(raw, plane, n_out=1))
+
+        # supervised-dispatch couplings: the numpy batch surfaces are
+        # the ground-truth twin (the serve host executor runs them —
+        # byte-identical pinned in tests/test_serve.py); rebuild
+        # re-derives the raw program post-demotion/reshrink
+        def host_twin(stack):
+            import numpy as np
+            s = np.asarray(stack)
+            if op == "encode":
+                return np.asarray(ec.encode_chunks_batch(s))
+            return np.asarray(ec.decode_chunks_batch(
+                s, available, erased))
+
+        def rebuild():
+            return serve_dispatch_call(ec, op, available, erased,
+                                       mesh=mesh)._raw
+
         ndev = plane.n_devices if plane is not None else 1
         # keyed on the PatternCache key: program identity includes
         # the profile, so rs_k4_m2 and rs_k8_m3 never share a row
@@ -426,8 +477,14 @@ def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
                     "serve_dispatch", eager=eager,
                     op=op, plugin=type(ec).__name__), \
                     prof.timed(pk, eager=eager):
-                return fn(stack)
+                if not eager:
+                    return fn(stack)
+                from ..ops.supervisor import global_supervisor
+                return global_supervisor().dispatch(
+                    f"engine.serve-{op}", fn, (stack,),
+                    host_fn=host_twin, rebuild=rebuild)
 
+        timed._raw = fn
         return timed
 
     return global_pattern_cache().get_or_build(key, build)

@@ -1305,6 +1305,28 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
         tel.counter("engine_mesh_dispatches", tier="crush-bulk",
                     devices=str(nd))
     jf = _get_jitted(cm, ruleno, result_max, *rungs[0], plane=plane)
+
+    # supervised dispatch seam (ops/supervisor.py): the first-rung
+    # block dispatch classifies transient/OOM/backend-loss failures;
+    # the host twin is the exact reference mapper the residue ladder
+    # already falls back to, so a demoted completion is bit-identical
+    # by the same invariant (host lanes never re-enter device rungs:
+    # the twin answers need_host=False)
+    from ..ops.supervisor import global_supervisor
+
+    def _host_block(xs_arr):
+        o_h = np.empty((len(xs_arr), result_max), np.int32)
+        c_h = np.empty(len(xs_arr), np.int32)
+        for j, x in enumerate(xs_arr):
+            r = crush_do_rule(cm.cmap, ruleno, int(x), result_max,
+                              weight=list(weight),
+                              choose_args=choose_args)
+            o_h[j] = r + [NONE] * (result_max - len(r))
+            c_h[j] = len(r)
+        return o_h, c_h, np.zeros(len(xs_arr), bool)
+
+    def _dev_block(xs_arr):
+        return jf(jnp.asarray(np.asarray(xs_arr)), wv)
     # cost-attribution capture for the fused rule program
     # (telemetry/profiler.py): the first block lowers once for XLA
     # cost_analysis (zero backend compiles — the jit cache above still
@@ -1332,7 +1354,12 @@ def bulk_do_rule(cmap, ruleno: int, xs, result_max: int,
                          engine="mesh" if nd > 1 else "device",
                          devices=nd)
         with prof.timed(prof_key, eager=_tel.enabled()):
-            o, c, nm = jf(xs_d, wv)
+            # verifiable=False: the device block legitimately differs
+            # from the reference twin (need-host flags feed the
+            # residue ladder), so CRC self-verify does not apply here
+            o, c, nm = global_supervisor().dispatch(
+                "crush.bulk_rule", _dev_block, (xs_b,),
+                host_fn=_host_block, verifiable=False)
             out[s:e] = np.asarray(o)[:e - s]
             cnt[s:e] = np.asarray(c)[:e - s]
             need[s:e] = np.asarray(nm)[:e - s]

@@ -28,11 +28,34 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Optional, Tuple
+from contextlib import contextmanager
+from typing import List, Optional, Tuple
 
 from ..utils.log import dout
 
 ENGINES = ("pallas", "xla", "numpy")
+
+_tls = threading.local()
+
+
+@contextmanager
+def numpy_tier():
+    """Thread-local numpy-tier override: inside the block every
+    ``engine()`` answer is ``"numpy"``, so the host batch surfaces
+    (codes/techniques.py) run the ground-truth numpy path without
+    mutating process state.  The supervised dispatch plane
+    (ops/supervisor.py) computes its self-verify ground truth and its
+    demoted-completion twins under this, so a verification pass can
+    never itself dispatch through the backend being verified."""
+    _tls.numpy = getattr(_tls, "numpy", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.numpy -= 1
+
+
+def _numpy_forced() -> bool:
+    return getattr(_tls, "numpy", 0) > 0
 
 # device kind reported when no XLA backend can initialize at all — the
 # numpy tier (the probe error is kept for the log line)
@@ -56,6 +79,11 @@ class FallbackPolicy:
         self._logged: set = set()
         self._lock = threading.Lock()
         self._kind: Optional[str] = None
+        # live-demotion stack (ops/supervisor.py): each demote()
+        # pushes the force it replaced so promote() restores exactly
+        self._demote_stack: List[Optional[str]] = []
+        self.demotions = 0
+        self.promotions = 0
 
     # -- probe -----------------------------------------------------------
 
@@ -65,8 +93,13 @@ class FallbackPolicy:
         jax.default_backend() raises RuntimeError when no platform
         initializes (and ImportError surfaces a broken install); both
         mean "drop to the numpy tier".  Nothing else is swallowed.
-        The probe result is cached — backend identity cannot change
-        mid-process, and the hot host paths ask on every batch.
+        The probe result is cached because the hot host paths ask on
+        every batch — but backend identity CAN change mid-process (a
+        tunnel drop, a device loss): the supervised dispatch plane
+        (ops/supervisor.py) calls :meth:`invalidate` / :meth:`demote`
+        to flip the cached answer live when a dispatch seam reports a
+        persistent backend failure, and :meth:`invalidate` again when
+        its health probe re-promotes.
         """
         if self._kind is not None:
             return self._kind
@@ -79,10 +112,65 @@ class FallbackPolicy:
         self._kind = kind
         return kind
 
+    def invalidate(self) -> None:
+        """Drop the cached probe result (and its error): the next
+        :meth:`device_kind` re-probes the backend.  The supervised
+        dispatch plane calls this around live demotion/re-promotion —
+        the one sanctioned way backend identity changes mid-process."""
+        with self._lock:
+            self._kind = None
+            self.probe_error = None
+
+    def demote(self, to: Optional[str] = None) -> str:
+        """LIVE tier demotion (ops/supervisor.py): force the next tier
+        down the pallas → xla → numpy ladder (or the explicit ``to``)
+        and invalidate the probe cache.  Returns the new tier.  Each
+        demotion pushes the force it replaced so :meth:`promote`
+        restores exactly; the transition is logged + counted like any
+        other tier change."""
+        cur = self.engine()
+        if to is None:
+            idx = ENGINES.index(cur) if cur in ENGINES else 0
+            to = ENGINES[min(idx + 1, len(ENGINES) - 1)]
+        if to not in ENGINES:
+            raise ValueError(f"demote target {to!r} must be one of "
+                             f"{ENGINES}")
+        with self._lock:
+            self._demote_stack.append(self.force)
+            self.force = to
+            self.demotions += 1
+        self.invalidate()
+        dout("ec", 1, f"backend fallback policy: LIVE demotion "
+                      f"{cur} -> {to}")
+        self._log_once(f"demoted-from-{cur}", to)
+        return to
+
+    def promote(self) -> Optional[str]:
+        """Undo the most recent :meth:`demote` (the health probe's
+        re-promotion); returns the restored engine tier, or None when
+        nothing was demoted."""
+        with self._lock:
+            if not self._demote_stack:
+                return None
+            self.force = self._demote_stack.pop()
+            self.promotions += 1
+        self.invalidate()
+        eng = self.engine()
+        dout("ec", 1, f"backend fallback policy: re-promoted to "
+                      f"engine={eng}")
+        return eng
+
+    @property
+    def demoted(self) -> bool:
+        with self._lock:
+            return bool(self._demote_stack)
+
     # -- selection -------------------------------------------------------
 
     def engine(self, device_kind: Optional[str] = None) -> str:
         """The engine tier for ``device_kind`` (probed when omitted)."""
+        if _numpy_forced():
+            return "numpy"
         if self.force is not None:
             kind = device_kind if device_kind is not None else "forced"
             self._log_once(kind, self.force, forced=True)

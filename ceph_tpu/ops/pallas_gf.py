@@ -403,6 +403,59 @@ def _run_matrix_packed(words: jax.Array, matrix_t, eng: str) -> jax.Array:
     return _bytes_to_packed(out)
 
 
+def _host_apply_bytes(chunks, matrix_t):
+    """Numpy ground-truth twin of the w=8 byte-layout dispatch (the
+    supervised plane's demoted-completion / self-verify reference)."""
+    from .xor_schedule import host_matrix_apply
+    arr = np.asarray(chunks)
+    return host_matrix_apply(arr, np.asarray(matrix_t),
+                             matrix_static=tuple(matrix_t), w=8)
+
+
+def _host_apply_packed(words, matrix_t):
+    """Packed-layout twin: packed words -> bytes (the numpy mirror of
+    ``_packed_to_bytes``'s little-endian bitcast), the byte twin, and
+    back — byte-identical to every device branch."""
+    arr = np.ascontiguousarray(np.asarray(words))
+    lead, (s, rows, lanes) = arr.shape[:-3], arr.shape[-3:]
+    byts = arr.view(np.uint8).reshape(lead + (s, rows * lanes * 4))
+    out = _host_apply_bytes(byts, matrix_t)
+    r = out.shape[-2]
+    return np.ascontiguousarray(out).reshape(
+        lead + (r, rows, lanes * 4)).view(np.uint32)
+
+
+def _supervised_matrix_dispatch(seam: str, x, matrix_t, w: int,
+                                packed: bool, mesh, eng: str):
+    """Route one eager matrix dispatch through the supervised plane
+    (ops/supervisor.py).  ``rebuild`` re-runs engine selection, so a
+    live tier demotion or plane reshrink lands the retried dispatch
+    on the demoted tier; the numpy twin completes at the floor."""
+    from .supervisor import global_supervisor
+
+    def body(v, _eng=eng):
+        if _eng == "numpy":
+            return (_host_apply_packed(v, matrix_t) if packed
+                    else _host_apply_bytes(v, matrix_t))
+        if _eng == "mesh":
+            return _apply_matrix_mesh(v, matrix_t, w, packed, mesh)
+        if packed:
+            return _run_matrix_packed(v, matrix_t, _eng)
+        return _run_matrix_bytes(v, matrix_t, w, _eng)
+
+    def rebuild():
+        eng2 = select_matrix_engine(x.shape, matrix_t, w,
+                                    packed=packed, mesh=mesh)
+        return lambda v: body(v, eng2)
+
+    host_fn = None
+    if w == 8:
+        host_fn = (lambda v: _host_apply_packed(v, matrix_t)) \
+            if packed else (lambda v: _host_apply_bytes(v, matrix_t))
+    return global_supervisor().dispatch(
+        seam, body, (x,), host_fn=host_fn, rebuild=rebuild)
+
+
 def apply_matrix_packed_best(words: jax.Array, matrix_t,
                              mesh=None) -> jax.Array:
     """Packed-layout dispatch through the selection table
@@ -421,9 +474,13 @@ def apply_matrix_packed_best(words: jax.Array, matrix_t,
     from ..telemetry.metrics import record_dispatch
     eng = select_matrix_engine(words.shape, matrix_t, 8, packed=True,
                                mesh=mesh)
-    with record_dispatch("ops_apply_matrix",
-                         eager=not isinstance(words, jax.core.Tracer),
+    eager = not isinstance(words, jax.core.Tracer)
+    with record_dispatch("ops_apply_matrix", eager=eager,
                          engine=eng, layout="packed"):
+        if eager:
+            return _supervised_matrix_dispatch(
+                "ops.apply_matrix_packed", words, matrix_t, 8, True,
+                mesh, eng)
         if eng == "mesh":
             return _apply_matrix_mesh(words, matrix_t, 8, True, mesh)
         return _run_matrix_packed(words, matrix_t, eng)
@@ -968,9 +1025,13 @@ def apply_matrix_best(chunks: jax.Array, matrix_t, w: int = 8,
                   or (w in (16, 32) and chunks.dtype == _WORD_DTYPE.get(w)))
     eng = (select_matrix_engine(chunks.shape, matrix_t, w, mesh=mesh)
            if word_typed else "xla")
-    with record_dispatch("ops_apply_matrix",
-                         eager=not isinstance(chunks, jax.core.Tracer),
+    eager = not isinstance(chunks, jax.core.Tracer)
+    with record_dispatch("ops_apply_matrix", eager=eager,
                          engine=eng, layout="bytes"):
+        if eager:
+            return _supervised_matrix_dispatch(
+                "ops.apply_matrix", chunks, matrix_t, w, False, mesh,
+                eng)
         if eng == "mesh":
             return _apply_matrix_mesh(chunks, matrix_t, w, False, mesh)
         return _run_matrix_bytes(chunks, matrix_t, w, eng)

@@ -97,7 +97,12 @@ def _build_plane(n_devices: Optional[int]) -> Optional[DataPlane]:
     try:
         import jax
         avail = len(jax.devices())
-    except Exception as e:  # noqa: BLE001 - no backend = no plane
+    except (RuntimeError, ImportError) as e:
+        # only the backend-init failure types jax actually raises
+        # (ops/fallback.py documents — and criticizes — the bare
+        # `except Exception` this probe used to share): RuntimeError
+        # from backend init, ImportError from a broken install.
+        # Anything else is a real bug and propagates.
         _degrade(f"no usable backend ({type(e).__name__}: {e})")
         return None
     n = avail if n_devices is None else min(n_devices, avail)

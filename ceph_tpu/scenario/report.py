@@ -42,6 +42,11 @@ class ScenarioReport:
     slo_burn_trips: int = 0
     gates: dict = field(default_factory=dict)
     profile: Optional[List[dict]] = None
+    # supervised dispatch plane (ops/supervisor.py): the run's
+    # supervisor-counter delta (retries/demotions/quarantines/
+    # re-promotions) + the chaos plan summary when the ScenarioSpec
+    # armed device-plane faults; None when the spec armed none
+    supervisor: Optional[dict] = None
 
     # -- convenience accessors (the contention axes) ---------------------
 
@@ -84,6 +89,8 @@ class ScenarioReport:
         }
         if self.profile is not None:
             out["profile"] = self.profile
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor
         return out
 
     def to_json(self) -> str:

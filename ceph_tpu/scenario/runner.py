@@ -449,6 +449,31 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
                    if (o % n_shards) in weights
                    and weights[o % n_shards] < 1.0}
 
+    # -- device-plane chaos (ISSUE 13): arm the seeded DispatchFault
+    # plan the spec schedules; the supervised dispatch plane
+    # (ops/supervisor.py) classifies and survives it, and the runner
+    # ticks the health probe every turn so a cleared fault
+    # re-promotes mid-run
+    from ..chaos.dispatch import DispatchFault, DispatchFaultPlan, \
+        arm_plan
+    from ..ops.supervisor import global_supervisor
+    dplan = None
+    prev_plan = None
+    sup = None
+    sup_before: dict = {}
+    if chaos.dispatch_fault:
+        sup = global_supervisor()
+        sup.reset_pacing()
+        sup_before = {k: v for k, v in sup.stats().items()
+                      if isinstance(v, int)}
+        dplan = DispatchFaultPlan(
+            [DispatchFault(chaos.dispatch_fault,
+                           seam=chaos.dispatch_fault_seam,
+                           at=chaos.dispatch_fault_at,
+                           calls=chaos.dispatch_fault_calls)],
+            seed=spec.seed + 404)
+        prev_plan = arm_plan(dplan)
+
     # -- QoS arbiter + throttle (the closed loop) ------------------------
     arbiter = MClockArbiter(spec.qos, clock=clock,
                             enabled=enable_arbiter)
@@ -483,6 +508,8 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
     def interleave() -> None:
         state["turns"] += 1
         tel.counter("scenario_turns")
+        if sup is not None:
+            sup.tick()
         now = clock.monotonic()
         if (len(churn.events) < chaos.storm_events
                 and now - t_start >= chaos.storm_at_s
@@ -509,20 +536,39 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
     # -- the client stream (with background interleaved) -----------------
     from ..serve.sla import SlaRecorder, SloPolicy
     sla = SlaRecorder(SloPolicy(deadlines=dict(spec.traffic.deadlines)))
-    serving = run_serving_scenario(
-        spec.traffic, clock=clock, executor=executor,
-        service_model=service_model, sla=sla,
-        interleave=interleave, on_result=on_result)
+    try:
+        serving = run_serving_scenario(
+            spec.traffic, clock=clock, executor=executor,
+            service_model=service_model, sla=sla,
+            interleave=interleave, on_result=on_result)
 
-    # -- post-stream: drain the storm, recovery to convergence -----------
-    drained = drain_churn(m, churn)
-    while (not state["converged"]
-           and orch.report.rounds < spec.max_recovery_rounds):
-        if arbiter.admit("recovery"):
-            run_recovery_round()
-        else:
-            clock.sleep(max(arbiter.hold_for("recovery"), _TICK))
-    elapsed = clock.monotonic() - t_start
+        # -- post-stream: drain the storm, heal the device plane,
+        # recovery to convergence -------------------------------------
+        drained = drain_churn(m, churn)
+        if dplan is not None:
+            # a persistent (calls=None) fault heals when the stream
+            # drains — the window-bounded ones cleared on their own;
+            # the health probe then re-promotes within promote_after
+            # clean ticks
+            dplan.clear()
+        while (not state["converged"]
+               and orch.report.rounds < spec.max_recovery_rounds):
+            if sup is not None:
+                sup.tick()
+            if arbiter.admit("recovery"):
+                run_recovery_round()
+            else:
+                clock.sleep(max(arbiter.hold_for("recovery"), _TICK))
+        if sup is not None:
+            # the backend healed: drive the probe to re-promotion so
+            # the run ends on the restored tier (bounded — tick() is
+            # a no-op once nothing is demoted)
+            for _ in range(sup.promote_after + 1):
+                sup.tick()
+        elapsed = clock.monotonic() - t_start
+    finally:
+        if dplan is not None:
+            arm_plan(prev_plan)
 
     # -- gates + report --------------------------------------------------
     rec = orch.report
@@ -569,6 +615,21 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
     if capture_profile:
         from ..telemetry.profiler import global_profiler
         profile = global_profiler().attribution_rows()
+    supervisor_section = None
+    if sup is not None:
+        after = sup.stats()
+        delta = {k: after[k] - sup_before.get(k, 0)
+                 for k in sup_before if isinstance(after.get(k), int)}
+        supervisor_section = {
+            "fault": {"kind": chaos.dispatch_fault,
+                      "seam": chaos.dispatch_fault_seam,
+                      "at": chaos.dispatch_fault_at,
+                      "calls": chaos.dispatch_fault_calls},
+            "counters": {k: v for k, v in sorted(delta.items()) if v},
+            "plan": dplan.summary(),
+            "demoted_at_end": after["demoted"],
+            "tier_floor_at_end": after["tier_floor"],
+        }
     report = ScenarioReport(
         name=spec.name, seed=spec.seed, executor=executor,
         arbiter_enabled=arbiter.enabled,
@@ -587,6 +648,7 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
             "unrecoverable": list(rec.unrecoverable),
         },
         profile=profile,
+        supervisor=supervisor_section,
     )
     tel.gauge("scenario_deadline_miss_rate",
               report.slo.get("deadline_miss_rate") or 0.0)

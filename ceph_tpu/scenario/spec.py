@@ -65,6 +65,19 @@ class ChaosSchedule:
     # background scrub verify ticks over the healthy objects, one per
     # admitted turn, up to this budget
     scrub_ticks: int = 8
+    # device-plane events (ISSUE 13, chaos/dispatch.py + the
+    # supervised dispatch plane ops/supervisor.py): lose the backend
+    # mid-stream.  ``dispatch_fault`` arms one seeded DispatchFault
+    # (transient|oom|backend_loss|hang|corrupt) against
+    # ``dispatch_fault_seam`` starting at that seam's
+    # ``dispatch_fault_at``-th call; it stays active for
+    # ``dispatch_fault_calls`` calls (None = until the runner heals
+    # the plan after the client stream drains).  None = no
+    # device-plane chaos (every pre-ISSUE-13 scenario JSON).
+    dispatch_fault: Optional[str] = None
+    dispatch_fault_seam: str = "engine.fused_repair"
+    dispatch_fault_at: int = 2
+    dispatch_fault_calls: Optional[int] = 4
 
     def to_dict(self) -> dict:
         return asdict(self)

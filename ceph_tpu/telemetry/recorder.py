@@ -57,7 +57,13 @@ MAX_DUMPS = 4
 MAX_DUMP_SPANS = 8
 
 TRIGGERS = ("unrecoverable", "crash_site", "recompile_budget",
-            "slo_burn", "backend_lost", "manual")
+            "slo_burn", "backend_lost", "manual",
+            # supervised dispatch plane (ops/supervisor.py): live
+            # tier demotion, mesh-member quarantine, health-probe
+            # re-promotion, and self-verify catching a corrupted
+            # output buffer
+            "backend_demoted", "device_quarantined", "repromoted",
+            "output_corruption")
 
 
 class _SystemClock:

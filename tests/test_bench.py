@@ -261,10 +261,10 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    # metric_version 9 (ISSUE 12): decode rows carry engine +
-    # xor_schedule provenance; tools/bench_diff.py gains the
-    # composite_decode category (tests/test_xor_schedule.py pins both)
-    assert bench.METRIC_VERSION == 9
+    # metric_version 10 (ISSUE 13): every line carries the supervised
+    # dispatch plane's counters + the device-chaos recovery rows
+    # (tests/test_supervisor.py pins the bench_diff category)
+    assert bench.METRIC_VERSION == 10
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
@@ -275,8 +275,17 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
                         lambda host_only=False: {})
     monkeypatch.setattr(bench, "_scenario_rows",
                         lambda host_only=False, requests=None: {})
+    monkeypatch.setattr(bench, "_device_chaos_rows",
+                        lambda host_only=False: {})
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["metric_version"] == bench.METRIC_VERSION
+    # metric_version 10: the device-chaos rows + the supervisor blob
+    # ride the error line too (a tunnel-down round records what the
+    # supervised plane did about it)
+    assert "device_chaos_rows" in err
+    assert dict(bench.DEVICE_CHAOS_ROWS)  # at least one declared row
+    assert isinstance(err["supervisor"], dict)
+    assert "demoted" in err["supervisor"]
     # metric_version 8: every line carries the scenario rows (the
     # composed production day under QoS arbitration — GB/s-under-SLO
     # and p99 under contention; docs/SCENARIOS.md)

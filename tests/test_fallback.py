@@ -121,3 +121,56 @@ def test_numpy_tier_pins_host_path(monkeypatch):
 def test_global_policy_is_process_wide():
     a = global_policy()
     assert global_policy() is a
+
+
+# -- live demotion / invalidation (ISSUE 13 satellite) -----------------
+
+def test_invalidate_reprobes(monkeypatch):
+    """The probe cache is no longer forever: invalidate() makes the
+    next device_kind() re-probe, so backend identity CAN change
+    mid-process (the supervised dispatch plane's contract)."""
+    import jax
+    p = FallbackPolicy(force=None)
+    answers = iter(["cpu", "tpu"])
+    monkeypatch.setattr(jax, "default_backend",
+                        lambda: next(answers))
+    assert p.device_kind() == "cpu"
+    assert p.device_kind() == "cpu"        # cached
+    p.invalidate()
+    assert p.device_kind() == "tpu"        # re-probed live
+
+
+def test_demote_walks_the_ladder_and_promote_restores():
+    p = FallbackPolicy(force="pallas")
+    assert p.engine() == "pallas"
+    assert p.demote() == "xla"
+    assert p.engine() == "xla" and p.demoted
+    assert p.demote() == "numpy"
+    assert p.engine() == "numpy"
+    assert p.demotions == 2
+    # promote pops the stack in reverse, restoring EXACTLY
+    assert p.promote() == "xla"
+    assert p.promote() == "pallas"
+    assert not p.demoted
+    assert p.promote() is None             # nothing left to restore
+    with pytest.raises(ValueError):
+        p.demote(to="cuda")
+
+
+def test_demote_explicit_target():
+    p = FallbackPolicy(force="pallas")
+    assert p.demote(to="numpy") == "numpy"
+    assert p.engine() == "numpy"
+    assert p.promote() == "pallas"
+
+
+def test_numpy_tier_context_is_thread_local_override():
+    from ceph_tpu.ops.fallback import numpy_tier
+    p = FallbackPolicy(force="xla")
+    assert p.engine() == "xla"
+    with numpy_tier():
+        assert p.engine() == "numpy"
+        with numpy_tier():                  # reentrant
+            assert p.engine() == "numpy"
+        assert p.engine() == "numpy"
+    assert p.engine() == "xla"

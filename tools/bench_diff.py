@@ -18,7 +18,9 @@ makes it a gate:
    noise floor, so it can never silently reopen), ``degraded:<row>``,
    ``serving:<row>`` (GB/s-under-SLO), ``multichip:<row>``,
    ``scenario:<row>`` (GB/s-under-SLO *under contention* — the
-   p99-under-contention gate of ISSUE 11), ``profile:<row>``.
+   p99-under-contention gate of ISSUE 11),
+   ``device_chaos:<row>`` (recovery-under-fault GB/s through the
+   supervised dispatch plane — ISSUE 13), ``profile:<row>``.
    Ratios/latency rows are deliberately excluded — one sentinel, one
    direction.
 3. **Diff with per-row noise floors** — the CURRENT record (BENCH_
@@ -69,6 +71,13 @@ FLOORS: Dict[str, float] = {
     # category by construction, but a silent p99-under-contention
     # cliff must still trip the sentinel
     "scenario": 0.55,
+    # recovery-under-fault (ISSUE 13): the supervised dispatch plane
+    # absorbing an injected transient/OOM/backend-loss script — the
+    # GB/s includes retries, rung splits, live demotion and program
+    # rebuilds on re-promotion, so it swings like the host-timed
+    # rows; a silent cliff (e.g. the supervisor thrashing the
+    # pattern cache) must still trip the sentinel
+    "device_chaos": 0.55,
     "profile": 0.60,
 }
 
@@ -96,6 +105,7 @@ def extract_series(rec: dict) -> Dict[str, float]:
     for section, cat in (("decode_rows", "decode"),
                          ("degraded_rows", "degraded"),
                          ("multichip_rows", "multichip"),
+                         ("device_chaos_rows", "device_chaos"),
                          ("profile_rows", "profile")):
         body = rec.get(section)
         if not isinstance(body, dict):

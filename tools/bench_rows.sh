@@ -126,6 +126,12 @@ run_row "row 11: production-day scenario — mixed client stream at SLO + churn 
     -s $((1<<14)) --workload scenario --requests 128 --batch 4 \
     -e 1 --storm-events 6 --json
 
+run_row "row 12: device-chaos — batched recovery through the supervised fused-repair seam while a seeded transient/OOM/backend-loss script fires mid-run (ISSUE 13; retries, rung downshifts, live demotion + re-promotion in the supervisor counters, metric_version 10)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<16)) --workload device-chaos --batch 8 --iterations 2 \
+    -e 1 --json
+
 run_row "row 5: 1M-PG bulk CRUSH sweep on device" \
     python tools/bulk_crush_row.py
 

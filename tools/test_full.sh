@@ -105,6 +105,19 @@ python tools/scenario_demo.py >/dev/null \
     || { echo "scenario_demo: scenario gate failed"; exit 1; }
 python tools/scenario_demo.py --erasures 4 >/dev/null 2>&1
 [ $? -eq 2 ] || { echo "scenario_demo: expected unrecoverable rc 2"; exit 1; }
+# Supervised-dispatch-plane gates (ISSUE 13 / docs/ROBUSTNESS.md
+# "Supervised dispatch plane"): a seeded production day that loses
+# its device backend mid-stream (persistent DispatchFault at the warm
+# fused-repair seam) must complete with a byte-identical heal vs the
+# unfailed control, a visible live demotion + flight-recorder dump,
+# and a logged re-promotion once the fault clears; in self-verify
+# mode an injected output-buffer bit flip must be CAUGHT and never
+# written back (rc 0) — and a past-budget damage mix must still exit
+# with the structured unrecoverable report (rc 2).
+python tools/device_chaos_demo.py --corrupt >/dev/null \
+    || { echo "device_chaos_demo: supervised dispatch gate failed"; exit 1; }
+python tools/device_chaos_demo.py --erasures 4 >/dev/null 2>&1
+[ $? -eq 2 ] || { echo "device_chaos_demo: expected unrecoverable rc 2"; exit 1; }
 # Simulated-mesh gate (ISSUE 8 / docs/PERF.md "Multi-chip data
 # plane"): the sharded engine tier must hold on an 8-way virtual CPU
 # mesh — trace audit of the sharded entry points (shard_map program
