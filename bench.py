@@ -110,6 +110,22 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # records WHY a decode number moved; tools/bench_diff.py gains the
 # `composite_decode` category tracking the shec/clay decode rows with
 # its own noise floor.  Consumers reading only `gbps` are unaffected.
+# v11 (ISSUE 14, roofline-closing autotuner): an `autotune_rows`
+# section — the profiler-driven config sweep over the bounded
+# declarative space (--workload autotune; ceph_tpu/tune/ +
+# tools/autotune.py): timed min-of-N candidate dispatches with
+# byte-identity asserted across every candidate tier, persisting
+# winners in the versioned best-config table, the row carrying the
+# tuner's own before/after utilization rows, the tuned-key list and
+# `utilization_pct` (the bench_diff `autotune` category series, so a
+# tuned config that later regresses fails CI).  On the tunnel-down
+# error path the same row runs the host-only ANALYTIC sweep (the
+# GF(2^8) roofline cost model, zero jax — honest provenance via
+# mode="analytic").  Additionally EVERY workload row now carries
+# `config_source` (tuned|default — was a best-config table installed
+# when the number was measured) and `tune_key_hash` (the installed
+# table's content hash; null on defaults), so tuned and default
+# numbers can never be silently compared across config regimes.
 # v10 (ISSUE 13, supervised dispatch plane): a `device_chaos_rows`
 # section — batched recovery driven through the supervised
 # fused-repair seam while a seeded DispatchFault script (transient,
@@ -123,7 +139,7 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # blob (the process supervisor's cumulative counters + demotion
 # state), so a round artifact shows whether the run survived device
 # faults and on which tier it finished.
-METRIC_VERSION = 10
+METRIC_VERSION = 11
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -302,6 +318,46 @@ DEVICE_CHAOS_ROW_FIELDS = ("supervisor", "faults_fired",
                            "demoted_at_end", "erasures", "verified")
 
 
+# Autotune rows (ISSUE 14): the profiler-driven config sweep for the
+# north-star shape — timed min-of-N candidate dispatches (device),
+# the host-only analytic roofline sweep on the tunnel-down error path
+# (argparse last-wins re-pins --device host).  utilization_pct is the
+# bench_diff `autotune` category series; the row also carries the
+# tuner's own before/after rows and the tuned-key list, so the round
+# artifact shows WHAT was tuned, not just that something was.
+AUTOTUNE_ROWS = [
+    ("rs_k8_m3_autotune",
+     ["--plugin", "jerasure", "--parameter", "technique=reed_sol_van",
+      "--parameter", "k=8", "--parameter", "m=3",
+      "--size", str(1 << 18), "--workload", "autotune",
+      "--device", "jax", "--batch", "16", "--iterations", "3",
+      "--seed", "42"]),
+]
+
+AUTOTUNE_ROW_FIELDS = ("mode", "n_tuned", "tuned_keys",
+                       "utilization_pct", "improvement_pct",
+                       "improved_rows", "rows", "verified")
+
+
+def _autotune_rows(host_only: bool = False) -> dict:
+    rows = {}
+    for name, argv in AUTOTUNE_ROWS:
+        row_argv = list(argv)
+        if host_only:
+            row_argv += ["--device", "host", "--iterations", "1"]
+        try:
+            res = _run(row_argv)
+            row = _row_result(res)
+            for f in AUTOTUNE_ROW_FIELDS:
+                row[f] = res.get(f)
+            rows[name] = row
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"autotune/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
+
+
 def _device_chaos_rows(host_only: bool = False) -> dict:
     rows = {}
     for name, argv in DEVICE_CHAOS_ROWS:
@@ -454,11 +510,17 @@ def _serving_rows(host_only: bool = False, requests: int | None = None
 
 def _row_result(res: dict, digits: int = 4) -> dict:
     """metric_version 3 row shape: GB/s plus the per-stripe-batch
-    latency percentiles the workload's histogram recorded."""
+    latency percentiles the workload's histogram recorded; since
+    metric_version 11 every row also carries its config provenance
+    (config_source tuned|default + the installed table's content
+    hash — ceph_tpu/tune/, docs/PERF.md 'Roofline-closing
+    autotuner')."""
     row = {"gbps": round(res["gbps"], digits)}
     for f in ("lat_p50_ms", "lat_p99_ms", "lat_p999_ms"):
         row[f] = (round(res[f], 4) if res.get(f) is not None else None)
     row["lat_samples"] = res.get("lat_samples")
+    row["config_source"] = res.get("config_source", "default")
+    row["tune_key_hash"] = res.get("tune_key_hash")
     return row
 
 
@@ -605,6 +667,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "profile_rows": _profile_rows(host_only=True),
         "scenario_rows": _scenario_rows(host_only=True, requests=64),
         "device_chaos_rows": _device_chaos_rows(host_only=True),
+        "autotune_rows": _autotune_rows(host_only=True),
         "last_good": _read_last_good(),
         "supervisor": _supervisor_blob(),
         "telemetry": _telemetry_blob(),
@@ -817,6 +880,7 @@ def main() -> int:
         "profile_rows": _profile_rows(),
         "scenario_rows": _scenario_rows(),
         "device_chaos_rows": _device_chaos_rows(),
+        "autotune_rows": _autotune_rows(),
         "lat_p50_ms": best.get("lat_p50_ms"),
         "lat_p99_ms": best.get("lat_p99_ms"),
         "lat_p999_ms": best.get("lat_p999_ms"),

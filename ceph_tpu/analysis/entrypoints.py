@@ -52,7 +52,7 @@ from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
 # topology-generated map + the rateless over-planned dispatch)
 FAMILIES = ("jerasure", "isa", "shec", "lrc", "clay",
             "engine", "ops", "crush", "scrub", "telemetry", "serve",
-            "cluster", "scenario")
+            "cluster", "scenario", "tune")
 
 # public device surfaces a plugin family can expose; the completeness
 # check requires every one present on a family's representative
@@ -764,6 +764,19 @@ def _build_fused_repair_supervised() -> Built:
                  fused_repair_call)
 
 
+def _build_tune_sweep() -> Built:
+    """The roofline-closing autotuner's analytic sweep as a host-tier
+    entry (ISSUE 14): a seeded sweep over the representative corpus,
+    run twice and pinned byte-identical, the emitted best-config
+    table schema-validated and round-tripped — ZERO jax compiles and
+    zero device arrays, forever.  The analytic sweep IS the
+    tunnel-down tuning path; a sweep that needed the device would be
+    useless exactly when the bench error line runs it."""
+    from ..tune.sweep import tune_sweep_selftest
+
+    return Built(tune_sweep_selftest, (), tune_sweep_selftest)
+
+
 def _build_scenario_qos() -> Built:
     """The mClock arbiter as a host-tier entry (ISSUE 11):
     reservation floor, weight pacing, limit ceiling and burn-rate
@@ -911,6 +924,13 @@ def registry() -> Tuple[EntryPoint, ...]:
         EntryPoint("engine.fused_repair_supervised", "engine", "jit",
                    _build_fused_repair_supervised, allow=GF_XLA_PRIMS,
                    trace_budget=16),
+        # the roofline-closing autotuner (ISSUE 14): the analytic
+        # sweep is host arithmetic forever — 0 compiles, 0 device
+        # arrays (its timed twin measures the already-audited engine
+        # programs; tuned CONFIGS are re-certified by the tuned-table
+        # audit test in tests/test_autotune.py)
+        EntryPoint("tune.sweep", "tune", "host",
+                   _build_tune_sweep, allow=None, trace_budget=0),
     ]
     return tuple(entries)
 

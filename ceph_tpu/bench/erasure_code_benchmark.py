@@ -134,7 +134,7 @@ class ErasureCodeBench:
                                  "repair-batched", "recovery-churn",
                                  "serving", "multichip", "cluster",
                                  "profile", "scenario",
-                                 "device-chaos"])
+                                 "device-chaos", "autotune"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -187,6 +187,14 @@ class ErasureCodeBench:
                         help="scenario workload: disable the mClock "
                              "QoS arbiter (the contention control "
                              "run)")
+        ap.add_argument("--tune-table", default=None, metavar="FILE",
+                        help="install this best-config table "
+                             "(tools/autotune.py output) before the "
+                             "workload — rows then report "
+                             "config_source=tuned; stale/mismatched "
+                             "entries fall back to defaults "
+                             "byte-identically (docs/PERF.md "
+                             "'Roofline-closing autotuner')")
         ap.add_argument("-E", "--erasures-generation", default="random",
                         choices=["random", "exhaustive"], dest="erasures_generation")
         ap.add_argument("--erased", action="append", type=int, default=None,
@@ -678,6 +686,13 @@ class ErasureCodeBench:
     def _result(self, workload: str, elapsed: float, total_bytes: int,
                 lat: "_LatTimer | None" = None) -> dict:
         gbps = total_bytes / elapsed / 1e9 if elapsed > 0 else float("inf")
+        # metric_version 11: every workload row is config-provenanced
+        # — which config regime (tuned best-config table vs the
+        # hand-picked defaults) produced this number, and the table's
+        # content hash so two tuned rows are comparable only when
+        # their tables match (ceph_tpu/tune/table.py)
+        from ..tune.table import active_source
+        config_source, tune_key_hash = active_source()
         res = {
             "workload": workload,
             "plugin": self.args.plugin,
@@ -692,6 +707,8 @@ class ErasureCodeBench:
             "chain": getattr(self.args, "chain", "carry"),
             "loop": getattr(self.args, "loop", 0),
             "gbps": gbps,
+            "config_source": config_source,
+            "tune_key_hash": tune_key_hash,
             **self._topology(),
         }
         if lat is not None and lat.hist.count:
@@ -704,6 +721,13 @@ class ErasureCodeBench:
 
     def run(self) -> dict:
         from ..utils.perf import global_perf, profile_trace
+        if self.args.tune_table:
+            # install the persisted best-config table BEFORE the
+            # workload builds any program (the consultation seams read
+            # it at build time); stays installed for the process —
+            # that is the point of --tune-table
+            from ..tune.table import BestConfigTable, install_table
+            install_table(BestConfigTable.load(self.args.tune_table))
         with profile_trace(self.args.profile_dir):
             res = self._run_workload()
         if self.args.dump_perf:
@@ -1543,7 +1567,59 @@ class ErasureCodeBench:
         res["verified"] = True
         return res
 
+    # -- autotune (the roofline-closing config search as a measured
+    # workload — ISSUE 14, ceph_tpu/tune/ + tools/autotune.py) ---------
+
+    def autotune_workload(self) -> dict:
+        """Profiler-driven config sweep as a bench row
+        (metric_version 11): timed min-of-N candidate dispatches with
+        byte-identity asserted across every candidate tier
+        (``--device jax``), or the host-only analytic roofline sweep
+        (``--device host`` — the tunnel-down error path, zero jax).
+        The row carries the before/after utilization rows the tuner
+        emitted, the tuned key list, and ``utilization_pct`` (the
+        best tuned program's after-utilization — the bench_diff
+        ``autotune`` category series, so a tuned config that later
+        regresses fails CI)."""
+        from ..tune import sweep as tsweep
+        a = self.args
+        begin = time.perf_counter()
+        if a.device == "jax":
+            rep = tsweep.timed_sweep(
+                plugin=a.plugin, profile=self.profile or None,
+                size=a.size, batch=a.batch,
+                repeats=max(2, a.iterations), seed=a.seed)
+        else:
+            rep = tsweep.analytic_sweep(seed=a.seed)
+        elapsed = time.perf_counter() - begin
+        # bytes actually priced/measured by the sweep (the attribution
+        # rows record arg_bytes x observed calls per program)
+        total_bytes = sum(
+            int(r["arg_bytes"]) * int(r["calls"] or 1)
+            for r in rep.attribution if r.get("arg_bytes"))
+        res = self._result("autotune", elapsed, max(1, total_bytes))
+        res["mode"] = rep.mode
+        res["seed"] = rep.seed
+        res["tuned_keys"] = sorted(rep.table.entries)
+        res["n_tuned"] = len(rep.table)
+        res["rows"] = rep.rows
+        utils = [r["after"].get("utilization_pct") for r in rep.rows
+                 if isinstance(r.get("after"), dict)
+                 and isinstance(r["after"].get("utilization_pct"),
+                                (int, float))]
+        res["utilization_pct"] = max(utils) if utils else None
+        head = rep.headline()
+        res["improvement_pct"] = (head or {}).get("improvement_pct")
+        res["improved_rows"] = len(rep.improved)
+        # timed mode asserts byte-identity across every candidate
+        # tier in-sweep (a raise aborts the row); analytic mode never
+        # dispatches, so there is nothing to diverge
+        res["verified"] = True
+        return res
+
     def _run_workload(self) -> dict:
+        if self.args.workload == "autotune":
+            return self.autotune_workload()
         if self.args.workload == "encode":
             return self.encode()
         if self.args.workload == "degraded":

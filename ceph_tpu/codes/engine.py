@@ -324,7 +324,13 @@ def fused_repair_call(ec, available: Tuple[int, ...],
         ndev = plane.n_devices if plane is not None else 1
         # the PatternCache key IS the program identity (class +
         # profile + kind + pattern + mesh) — reuse it so two profiles
-        # of one plugin class can never share an attribution row
+        # of one plugin class can never share an attribution row.
+        # config records whether this program was BUILT under a tuned
+        # best-config table (ISSUE 14: consultation happens at build
+        # time, inside this cached builder, so tuned configs ride the
+        # warm path with zero recompiles; installing a table clears
+        # this cache, so the label can never go stale)
+        from ..tune.table import active_source
         prof_key = ("prof",) + key
         prof_labels = dict(
             plugin=type(ec).__name__, kind="fused-repair",
@@ -332,7 +338,7 @@ def fused_repair_call(ec, available: Tuple[int, ...],
                              sorted(ec.get_profile().items())),
             pattern="e" + "_".join(map(str, erased)),
             engine="mesh" if plane is not None else "device",
-            devices=ndev)
+            devices=ndev, config=active_source()[0])
 
         def timed(stack):
             # host-side dispatch latency histogram.  Tracer inputs
@@ -444,7 +450,10 @@ def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
 
         ndev = plane.n_devices if plane is not None else 1
         # keyed on the PatternCache key: program identity includes
-        # the profile, so rs_k4_m2 and rs_k8_m3 never share a row
+        # the profile, so rs_k4_m2 and rs_k8_m3 never share a row;
+        # config = tuned|default records which config regime BUILT
+        # this program (ISSUE 14 — see fused_repair_call)
+        from ..tune.table import active_source
         prof_key = ("prof",) + key
         prof_labels = dict(
             plugin=type(ec).__name__, kind=f"serve-{op}",
@@ -452,7 +461,7 @@ def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
                              sorted(ec.get_profile().items())),
             pattern="e" + "_".join(map(str, erased)),
             engine="mesh" if plane is not None else "device",
-            devices=ndev)
+            devices=ndev, config=active_source()[0])
 
         def timed(stack):
             # same trace-eagerness discipline as fused_repair_call:

@@ -140,8 +140,27 @@ def _row_tile(rows: int, sublane: int, cap: int) -> int:
     return 0
 
 
-def _row_tile8(rows: int) -> int:
-    return _row_tile(rows, SUBLANE_U8, MAX_ROW_TILE8)
+def _row_tile8(rows: int, cap: int | None = None) -> int:
+    return _row_tile(rows, SUBLANE_U8, cap or MAX_ROW_TILE8)
+
+
+def tuned_row_tile_cap(packed: bool) -> int | None:
+    """The autotuner's row-tile consultation seam (ISSUE 14): the
+    tuned u8 row-tile cap for this layout from the installed
+    best-config table, or None (= MAX_ROW_TILE8 byte-identically).
+    The cap is a STATIC argument of the kernel wrappers, so a tuned
+    value is part of the jit cache key: installed before warmup it
+    costs nothing warm; installed mid-process it rebuilds once (the
+    table install clears the pattern cache for exactly this reason)."""
+    from ..tune.table import consult
+    cfg = consult("row-tile", engine="pallas",
+                  layout="packed" if packed else "bytes")
+    if cfg:
+        v = cfg.get("max_row_tile8")
+        if (isinstance(v, int) and not isinstance(v, bool)
+                and v >= SUBLANE_U8 and v % SUBLANE_U8 == 0):
+            return v
+    return None
 
 
 def pallas_matrix_supported(shape, w: int) -> bool:
@@ -172,14 +191,17 @@ def pallas_matrix_padded_supported(shape, w: int) -> bool:
     return c > 0 and c % LANE == 0
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def apply_matrix_pallas(chunks: jax.Array, matrix_t,
-                        interpret: bool = False) -> jax.Array:
+                        interpret: bool = False,
+                        row_tile_cap: int | None = None) -> jax.Array:
     """Apply a static (r, s) GF(2^8) matrix to (..., s, C) uint8
     chunks -> (..., r, C) parity/decode output.  Same contract as
     xla_ops.apply_matrix_xla (w=8); caller gates on
     pallas_matrix_padded_supported (row counts off the native sublane
-    tile are zero-padded and the pad rows masked off on writeback)."""
+    tile are zero-padded and the pad rows masked off on writeback).
+    ``row_tile_cap`` (static): the autotuned VMEM row-tile ceiling —
+    partitioning only, byte-identical at any legal value."""
     r = len(matrix_t)
     s = len(matrix_t[0])
     assert chunks.shape[-2] == s and chunks.dtype == jnp.uint8
@@ -192,7 +214,7 @@ def apply_matrix_pallas(chunks: jax.Array, matrix_t,
     if pad:
         tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, pad), (0, 0)))
     prows = rows + pad
-    rt = _row_tile8(prows)
+    rt = _row_tile8(prows, row_tile_cap)
     out = pl.pallas_call(
         _gf8_matrix_kernel(matrix_t, s, r, interpret),
         grid=(b, prows // rt),
@@ -324,11 +346,14 @@ def pallas_matrix_packed_supported(shape) -> bool:
     return len(shape) >= 3 and shape[-1] == LANE and shape[-2] >= 1
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def apply_matrix_pallas_packed(words: jax.Array, matrix_t,
-                               interpret: bool = False) -> jax.Array:
+                               interpret: bool = False,
+                               row_tile_cap: int | None = None
+                               ) -> jax.Array:
     """Packed-layout apply: (..., s, R, 128) uint32 -> (..., r, R, 128).
-    Same math as apply_matrix_pallas (w=8), zero layout work.
+    Same math as apply_matrix_pallas (w=8), zero layout work,
+    same (static) autotuned ``row_tile_cap`` seam.
 
     Accepts ARBITRARY (r, s) composite matrices and row counts: a row
     count off the native u32 sublane tile is zero-padded up to it and
@@ -346,7 +371,7 @@ def apply_matrix_pallas_packed(words: jax.Array, matrix_t,
     if pad:
         tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, pad), (0, 0)))
     prows = rows + pad
-    rt = _row_tile8(prows * 4) // 4
+    rt = _row_tile8(prows * 4, row_tile_cap) // 4
     if rt == 0 or prows % rt:
         rt = prows  # small shapes: one block per chunk
     out = pl.pallas_call(
@@ -392,13 +417,17 @@ def _run_matrix_packed(words: jax.Array, matrix_t, eng: str) -> jax.Array:
     if eng == "xor":
         sched = _xor_sched_static(matrix_t)
         if use_pallas() and pallas_matrix_packed_supported(words.shape):
-            return apply_matrix_xor_packed(words, sched)
+            return apply_matrix_xor_packed(words, sched,
+                                           row_tile_cap=
+                                           tuned_row_tile_cap(True))
         return apply_matrix_xor_xla_packed(words, sched)
     if eng == "mxu":
         out = xla_ops.apply_matrix_mxu(_packed_to_bytes(words), matrix_t)
         return _bytes_to_packed(out)
     if eng == "pallas":
-        return apply_matrix_pallas_packed(words, matrix_t)
+        return apply_matrix_pallas_packed(words, matrix_t,
+                                          row_tile_cap=
+                                          tuned_row_tile_cap(True))
     out = xla_ops.apply_matrix_xla(_packed_to_bytes(words), matrix_t, 8)
     return _bytes_to_packed(out)
 
@@ -590,11 +619,14 @@ def _xor_matrix_kernel(sched_static, s: int, r: int, pack, unpack):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def apply_matrix_xor_pallas(chunks: jax.Array, sched_static,
-                            interpret: bool = False) -> jax.Array:
+                            interpret: bool = False,
+                            row_tile_cap: int | None = None
+                            ) -> jax.Array:
     """Byte-layout XOR-scheduled apply: (..., s, C) uint8 ->
-    (..., r, C), same contract (and same pad-and-mask row tiling) as
+    (..., r, C), same contract (and same pad-and-mask row tiling,
+    same static autotuned ``row_tile_cap`` seam) as
     apply_matrix_pallas; the matrix is baked into ``sched_static``
     (xor_schedule.XorSchedule.static)."""
     _, s, r, _, _ = sched_static
@@ -608,7 +640,7 @@ def apply_matrix_xor_pallas(chunks: jax.Array, sched_static,
     if pad:
         tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, pad), (0, 0)))
     prows = rows + pad
-    rt = _row_tile8(prows)
+    rt = _row_tile8(prows, row_tile_cap)
     out = pl.pallas_call(
         _xor_matrix_kernel(sched_static, s, r,
                            lambda v: _pack_words(v, interpret),
@@ -628,13 +660,16 @@ def apply_matrix_xor_pallas(chunks: jax.Array, sched_static,
     return out.reshape(lead + (r, c))
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def apply_matrix_xor_packed(words: jax.Array, sched_static,
-                            interpret: bool = False) -> jax.Array:
+                            interpret: bool = False,
+                            row_tile_cap: int | None = None
+                            ) -> jax.Array:
     """Packed-layout XOR-scheduled apply: (..., s, R, 128) uint32 ->
     (..., r, R, 128) — the resident-word twin of
     apply_matrix_pallas_packed (identity register pack, arbitrary row
-    counts via zero-pad + masked writeback)."""
+    counts via zero-pad + masked writeback, same static autotuned
+    ``row_tile_cap`` seam)."""
     _, s, r, _, _ = sched_static
     assert words.shape[-3] == s and words.dtype == jnp.uint32
     assert words.shape[-1] == LANE
@@ -646,7 +681,7 @@ def apply_matrix_xor_packed(words: jax.Array, sched_static,
     if pad:
         tiles = jnp.pad(tiles, ((0, 0), (0, 0), (0, pad), (0, 0)))
     prows = rows + pad
-    rt = _row_tile8(prows * 4) // 4
+    rt = _row_tile8(prows * 4, row_tile_cap) // 4
     if rt == 0 or prows % rt:
         rt = prows
     ident = lambda v: v  # noqa: E731
@@ -705,9 +740,14 @@ def apply_matrix_xor_xla_packed(words: jax.Array,
 
 def _xor_sched_static(matrix_t):
     """The schedule the selection table routed ``matrix_t`` to (the
-    probe is lru-cached, so this is a dict hit on the dispatch path)."""
-    from .xor_schedule import preferred_schedule
-    sched = preferred_schedule(matrix_t, 8, mxu_min=MXU_MATRIX_MIN)
+    probe is lru-cached, so this is a dict hit on the dispatch path).
+    A tuned engine PIN (ISSUE 14) may route to the xor tier past the
+    cutover heuristic — measurement beat the model — so when the
+    preference probe declines, fall through to the raw schedule."""
+    from .xor_schedule import preferred_schedule, probe_schedule
+    sched = preferred_schedule(matrix_t, 8, mxu_min=mxu_matrix_min())
+    if sched is None:
+        sched = probe_schedule(matrix_t, 8)
     assert sched is not None, "xor tier selected without a schedule"
     return sched.static
 
@@ -829,6 +869,21 @@ def use_pallas() -> bool:
 MXU_MATRIX_MIN = 2048
 
 
+def mxu_matrix_min() -> int:
+    """The MXU nonzero cutover: the tuned value from the installed
+    best-config table (kind ``engine-select``), else MXU_MATRIX_MIN —
+    the autotuner's threshold consultation seam (ISSUE 14).  Every
+    tier is byte-identical, so a tuned cutover moves only WHERE the
+    product runs."""
+    from ..tune.table import consult
+    cfg = consult("engine-select")
+    if cfg:
+        v = cfg.get("mxu_matrix_min")
+        if isinstance(v, int) and not isinstance(v, bool) and v > 0:
+            return v
+    return MXU_MATRIX_MIN
+
+
 @functools.lru_cache(maxsize=256)
 def _matrix_nnz(matrix_t) -> int:
     # cached: matrix_t is the hashable static tuple, and this runs in
@@ -844,6 +899,49 @@ def _resolve_mesh(mesh):
     itself, falsy -> mesh tier disabled."""
     from ..parallel.plane import resolve_plane
     return resolve_plane(mesh)
+
+
+def _tuned_engine_pin(shape, matrix_t, w: int, packed: bool,
+                      engine: str) -> str | None:
+    """The autotuner's per-matrix tier pin (ISSUE 14): the measured
+    winner for this static matrix from the best-config table (kind
+    ``matrix-engine``, profile slot ``m:<digest>``), VALIDATED against
+    what this shape/backend can actually dispatch — an undispatchable
+    pin falls back to the heuristic table byte-identically, it never
+    errors.  Every tier computes identical bytes, so a pin moves only
+    where the product runs."""
+    if w != 8 or not matrix_t:
+        return None
+    from ..tune.table import consult, matrix_digest
+    cfg = consult("matrix-engine",
+                  profile="m:" + matrix_digest(matrix_t),
+                  layout="packed" if packed else "bytes",
+                  device_count=1)
+    if cfg is None:
+        # most pins are written under the bytes layout; a packed
+        # dispatch of the same matrix runs the same tier
+        cfg = consult("matrix-engine",
+                      profile="m:" + matrix_digest(matrix_t),
+                      layout="bytes", device_count=1)
+    if not cfg:
+        return None
+    pin = cfg.get("engine")
+    if pin == "xla":
+        return "xla"
+    if pin == "xor":
+        from .xor_schedule import probe_schedule
+        ok = (packed or (len(shape) >= 2 and shape[-1] % 4 == 0)) \
+            and probe_schedule(matrix_t, 8) is not None
+        return "xor" if ok else None
+    if engine != "pallas":
+        return None          # mxu/pallas pins need the TPU tier live
+    if pin == "mxu":
+        return "mxu"
+    if pin == "pallas":
+        sup = (pallas_matrix_packed_supported(shape) if packed
+               else pallas_matrix_padded_supported(shape, 8))
+        return "pallas" if sup else None
+    return None
 
 
 def select_matrix_engine(shape, matrix_t, w: int = 8,
@@ -882,8 +980,10 @@ def select_matrix_engine(shape, matrix_t, w: int = 8,
 
     ``engine`` overrides the probed fallback-policy tier and ``mesh``
     the active data plane (tests).  Pure function of its arguments
-    plus the two process policies — the routing tests assert on it
-    directly."""
+    plus the three process policies (fallback tier, data plane, and
+    the installed best-config table — a tuned per-matrix pin or
+    cutover threshold reroutes here, ISSUE 14) — the routing tests
+    assert on it directly."""
     if engine is None:
         from .fallback import global_policy
         engine = global_policy().engine(_device_kind())
@@ -893,20 +993,31 @@ def select_matrix_engine(shape, matrix_t, w: int = 8,
     if (plane is not None and plane.n_devices > 1
             and len(shape) >= (4 if packed else 3) and shape[0] >= 2):
         return "mesh"
+    # the autotuner's per-matrix pin (ISSUE 14): a measured winner in
+    # the installed best-config table overrides the heuristics below
+    # — validated as dispatchable, byte-identical by construction,
+    # and consulted AFTER the numpy/mesh topology decisions (a pin
+    # can choose a kernel, never resurrect a dead backend or unshard
+    # a plane)
+    pin = _tuned_engine_pin(shape, matrix_t, w, packed, engine)
+    if pin is not None:
+        return pin
     # the XOR-density probe (ops/xor_schedule.py): a schedulable w=8
     # matrix whose scheduled op count beats the dense-multiply model
     # runs the scheduled kernel family on BOTH device tiers (Pallas on
-    # TPU, the XLA build of the same schedule elsewhere)
+    # TPU, the XLA build of the same schedule elsewhere); the cutover
+    # thresholds are themselves tuned-table seams (mxu_matrix_min,
+    # tuned_xor_cutover)
     if (w == 8 and matrix_t
             and (packed or (len(shape) >= 2 and shape[-1] % 4 == 0))):
         from .xor_schedule import preferred_schedule
         if preferred_schedule(matrix_t, 8,
-                              mxu_min=MXU_MATRIX_MIN) is not None:
+                              mxu_min=mxu_matrix_min()) is not None:
             return "xor"
     if engine != "pallas":
         return "xla"
     nnz = _matrix_nnz(matrix_t) if matrix_t else 0
-    if w == 8 and nnz >= MXU_MATRIX_MIN:
+    if w == 8 and nnz >= mxu_matrix_min():
         return "mxu"
     if packed:
         return "pallas" if pallas_matrix_packed_supported(shape) else "xla"
@@ -930,7 +1041,9 @@ def _run_matrix_bytes(chunks: jax.Array, matrix_t, w: int,
         sched = _xor_sched_static(matrix_t)
         if use_pallas() and pallas_matrix_padded_supported(chunks.shape,
                                                           8):
-            return apply_matrix_xor_pallas(chunks, sched)
+            return apply_matrix_xor_pallas(chunks, sched,
+                                           row_tile_cap=
+                                           tuned_row_tile_cap(False))
         return apply_matrix_xor_xla(chunks, sched)
     if eng == "mxu":
         # module attribute (not a local import) so the routing test
@@ -938,7 +1051,9 @@ def _run_matrix_bytes(chunks: jax.Array, matrix_t, w: int,
         return xla_ops.apply_matrix_mxu(chunks, matrix_t)
     if eng == "pallas":
         if w == 8:
-            return apply_matrix_pallas(chunks, matrix_t)
+            return apply_matrix_pallas(chunks, matrix_t,
+                                       row_tile_cap=
+                                       tuned_row_tile_cap(False))
         return apply_matrix_pallas_words(chunks, matrix_t, w)
     return apply_matrix_xla(chunks, matrix_t, w)
 

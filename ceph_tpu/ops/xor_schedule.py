@@ -347,14 +347,15 @@ def _finish(em: _Emitter, outputs: List[int], matrix_t, naive: int,
         transform=transform)
 
 
-def _build_cse(matrix_t, naive: int, dense_vpu: int) -> XorSchedule:
+def _build_cse(matrix_t, naive: int, dense_vpu: int,
+               topk: int = CSE_TOPK) -> XorSchedule:
     s = len(matrix_t[0])
     rows = _bit_rows(matrix_t)
     # equations per output BYTE, on the doubling-plane domain: the 8
     # bit-equations of a byte share planes heavily (they are the bit
     # decomposition of one XOR-of-xtime-planes sum), so the byte-level
     # rows ARE the grouped bit-matrix equations
-    temps, final_rows = _greedy_cse(rows, s * W)
+    temps, final_rows = _greedy_cse(rows, s * W, topk=topk)
     n_planes = s * W
     # which doubling planes must materialize: referenced by rows or by
     # temp definitions (temps reference ORIGINAL operands permanently)
@@ -388,8 +389,8 @@ def _build_cse(matrix_t, naive: int, dense_vpu: int) -> XorSchedule:
     return _finish(em, outputs, matrix_t, naive, dense_vpu, "cse")
 
 
-def _build_ring(matrix_t, shifts, naive: int,
-                dense_vpu: int) -> Optional[XorSchedule]:
+def _build_ring(matrix_t, shifts, naive: int, dense_vpu: int,
+                topk: int = CSE_TOPK) -> Optional[XorSchedule]:
     """The 1701.07731 lazy-reduction schedule for monomial matrices:
     accumulate out[i] = sum_j x^sh_ij * in_j in F2[x] as a (low,
     overflow) byte-plane pair — shifts are byte-local shift pairs,
@@ -419,7 +420,7 @@ def _build_ring(matrix_t, shifts, naive: int,
         lo_rows.append(lo)
         hi_rows.append(hi)
     n_vars = len(plane_vars)
-    temps, folded = _greedy_cse(lo_rows + hi_rows, n_vars)
+    temps, folded = _greedy_cse(lo_rows + hi_rows, n_vars, topk=topk)
     em = _Emitter(s)
     node_of: Dict[int, int] = {}
     for key, var in sorted(plane_vars.items(), key=lambda kv: kv[1]):
@@ -461,20 +462,26 @@ def _build_ring(matrix_t, shifts, naive: int,
     return _finish(em, outputs, matrix_t, naive, dense_vpu, "ring")
 
 
-def build_schedule(matrix_t, w: int = 8) -> XorSchedule:
+def build_schedule(matrix_t, w: int = 8,
+                   topk: Optional[int] = None) -> XorSchedule:
     """Schedule one static (r, s) GF(2^8) matrix: the cheaper of the
     CSE schedule and (for monomial-subset matrices) the ring-transform
-    schedule, deterministic given the matrix."""
+    schedule, deterministic given the matrix (and the CSE candidate
+    horizon ``topk`` — None = the tuned/default CSE_TOPK, the
+    autotuner's ``xor-schedule`` consultation seam)."""
     if w != W:
         raise ValueError(f"XOR scheduling is w=8 only, got w={w}")
     if not matrix_t or not matrix_t[0]:
         raise ValueError("empty matrix")
+    if topk is None:
+        topk = tuned_cse_topk()
     naive = naive_bitmatrix_xors(matrix_t)
     dense_vpu = dense_vpu_cost(matrix_t)
-    sched = _build_cse(matrix_t, naive, dense_vpu)
+    sched = _build_cse(matrix_t, naive, dense_vpu, topk=topk)
     shifts = _monomial_shifts(matrix_t)
     if shifts is not None:
-        ring = _build_ring(matrix_t, shifts, naive, dense_vpu)
+        ring = _build_ring(matrix_t, shifts, naive, dense_vpu,
+                           topk=topk)
         # ring wins only on the full cost model AND without breaking
         # the never-worse-than-naive XOR property
         if ring is not None and ring.vpu_ops < sched.vpu_ops \
@@ -486,34 +493,79 @@ def build_schedule(matrix_t, w: int = 8) -> XorSchedule:
 # ----------------------------------------------------------------------
 # the probe (what select_matrix_engine consults)
 
+def tuned_cse_topk() -> int:
+    """The greedy-CSE candidate horizon: the tuned value from the
+    installed best-config table (kind ``xor-schedule``), else
+    CSE_TOPK byte-identically — the schedule changes op COUNT only,
+    never output bytes (ISSUE 14 consultation seam)."""
+    from ..tune.table import consult
+    cfg = consult("xor-schedule")
+    if cfg:
+        v = cfg.get("cse_topk")
+        if isinstance(v, int) and not isinstance(v, bool) and v > 0:
+            return v
+    return CSE_TOPK
+
+
+def tuned_xor_cutover() -> Tuple[int, int]:
+    """The XOR/dense cutover ratio (num, den): tuned from the table
+    (kind ``engine-select``), else XOR_DENSE_CUTOVER.  Still an
+    integer ratio — no float sneaks into GF-lane code via the tuner."""
+    from ..tune.table import consult
+    cfg = consult("engine-select")
+    if cfg:
+        v = cfg.get("xor_cutover")
+        try:
+            num, den = int(v[0]), int(v[1])
+        except (TypeError, ValueError, IndexError):
+            return XOR_DENSE_CUTOVER
+        if num > 0 and den > 0:
+            return num, den
+    return XOR_DENSE_CUTOVER
+
+
 @functools.lru_cache(maxsize=256)
-def probe_schedule(matrix_t, w: int = 8) -> Optional[XorSchedule]:
-    """Build-and-cache the schedule for a static matrix, or None when
-    the matrix is out of scope (w != 8, or its bit-matrix expansion
-    exceeds the scheduling budget — huge composites stay on the
-    MXU/dense tiers).  lru-cached on the hashable static tuple, so
-    the per-dispatch cost after the first call is a dict hit."""
+def _probe_schedule_cached(matrix_t, w: int,
+                           topk: int) -> Optional[XorSchedule]:
     if w != W or not matrix_t or not matrix_t[0]:
         return None
     ones = sum(bitmatrix_n_ones(int(e))
                for row in matrix_t for e in row if e)
     if ones == 0 or ones > _max_ones():
         return None
-    return build_schedule(matrix_t, w)
+    return build_schedule(matrix_t, w, topk=topk)
+
+
+def probe_schedule(matrix_t, w: int = 8) -> Optional[XorSchedule]:
+    """Build-and-cache the schedule for a static matrix, or None when
+    the matrix is out of scope (w != 8, or its bit-matrix expansion
+    exceeds the scheduling budget — huge composites stay on the
+    MXU/dense tiers).  lru-cached on (static tuple, CSE horizon), so
+    the per-dispatch cost after the first call is a dict hit and a
+    tuned-table install (which changes the horizon) can never serve a
+    schedule built under the old config."""
+    return _probe_schedule_cached(matrix_t, w, tuned_cse_topk())
+
+
+# tests and tune.table.install_table clear the probe through the
+# public name (the lru cache moved to the inner function)
+probe_schedule.cache_clear = _probe_schedule_cached.cache_clear
+probe_schedule.cache_info = _probe_schedule_cached.cache_info
 
 
 def preferred_schedule(matrix_t, w: int = 8,
                        mxu_min: Optional[int] = None,
                        ) -> Optional[XorSchedule]:
     """The XOR-density decision: the schedule, iff the cost model says
-    it beats the dense unrolled kernel by the cutover margin — and,
+    it beats the dense unrolled kernel by the cutover margin (tuned
+    via the best-config table, default XOR_DENSE_CUTOVER) — and,
     above the MXU nonzero threshold (``mxu_min``), only when the
     schedule also undercuts one op per nonzero (the regime where even
     a systolic matmul loses to a structured XOR chain)."""
     sched = probe_schedule(matrix_t, w)
     if sched is None:
         return None
-    num, den = XOR_DENSE_CUTOVER
+    num, den = tuned_xor_cutover()
     if sched.vpu_ops * den > num * sched.dense_vpu_ops:
         return None
     if mxu_min is not None:
@@ -680,6 +732,7 @@ __all__ = [
     "dense_vpu_cost", "eval_schedule", "eval_schedule_u8",
     "host_matrix_apply", "naive_bitmatrix_xors",
     "preferred_schedule", "probe_bitmatrix_schedule",
-    "probe_schedule", "xtime_words_xor",
+    "probe_schedule", "tuned_cse_topk", "tuned_xor_cutover",
+    "xtime_words_xor",
     "XOR_DENSE_CUTOVER", "BITMATRIX_MIN_SAVINGS",
 ]

@@ -90,10 +90,29 @@ def single_device():
         _tls.depth -= 1
 
 
+def tuned_fanout() -> Optional[int]:
+    """The autotuner's shard fan-out seam (ISSUE 14): the tuned width
+    for an AUTO-activated plane from the installed best-config table
+    (kind ``mesh-fanout``), or None (= every visible device, today's
+    behavior).  An explicit ``activate(N)`` / ``CEPH_TPU_MESH=N``
+    always wins — tuning narrows the default, it never overrides an
+    operator."""
+    from ..tune.table import consult
+    cfg = consult("mesh-fanout", engine="mesh")
+    if cfg:
+        v = cfg.get("n_devices")
+        if isinstance(v, int) and not isinstance(v, bool) and v >= 1:
+            return v
+    return None
+
+
 def _build_plane(n_devices: Optional[int]) -> Optional[DataPlane]:
     """A tp=1 (pure-dp) plane over the first n devices, or None when a
     mesh cannot form — the degrade-to-single-device path, logged and
-    counted, never silent."""
+    counted, never silent.  An auto plane (``n_devices=None``)
+    consults the tuned fan-out width first."""
+    if n_devices is None:
+        n_devices = tuned_fanout()
     try:
         import jax
         avail = len(jax.devices())

@@ -52,6 +52,26 @@ from .queue import AdmissionQueue, EcRequest, EcResult
 # of these, so steady-state traffic holds |ladder| programs per bucket
 LADDER = (1, 4, 16, 64)
 
+
+def tuned_ladder(default: Tuple[int, ...] = LADDER) -> Tuple[int, ...]:
+    """The autotuner's rung-ladder consultation seam (ISSUE 14): the
+    tuned ladder from the installed best-config table (kind
+    ``serve-ladder``), validated strictly-increasing positive ints,
+    else ``default`` byte-identically.  Consulted at batcher BUILD
+    time only — a running batcher's ladder (and its warmed program
+    set) never changes underneath it."""
+    from ..tune.table import consult
+    cfg = consult("serve-ladder")
+    if cfg:
+        lad = cfg.get("ladder")
+        try:
+            t = tuple(int(x) for x in lad)
+        except (TypeError, ValueError):
+            return tuple(default)
+        if t and all(x > 0 for x in t) and t == tuple(sorted(set(t))):
+            return t
+    return tuple(default)
+
 # EWMA smoothing for the per-bucket service-time estimate
 _EWMA_ALPHA = 0.3
 
@@ -110,12 +130,18 @@ class ContinuousBatcher:
     (the determinism contract tests/test_serve.py pins).
     """
 
-    def __init__(self, clock=None, ladder: Tuple[int, ...] = LADDER,
+    def __init__(self, clock=None,
+                 ladder: Optional[Tuple[int, ...]] = None,
                  executor: str = "device",
                  service_model: Optional[Callable] = None,
                  min_slack: float = _MIN_SLACK) -> None:
         from ..utils.retry import SystemClock
 
+        if ladder is None:
+            # the autotuner's seam: the tuned rung ladder when a
+            # best-config table is installed, LADDER otherwise (an
+            # explicit ladder — scenario specs, tests — always wins)
+            ladder = tuned_ladder()
         if executor not in ("device", "host"):
             raise ValueError(f"executor {executor!r} must be "
                              f"device|host")

@@ -261,10 +261,13 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    # metric_version 10 (ISSUE 13): every line carries the supervised
-    # dispatch plane's counters + the device-chaos recovery rows
-    # (tests/test_supervisor.py pins the bench_diff category)
-    assert bench.METRIC_VERSION == 10
+    # metric_version 11 (ISSUE 14): every workload row carries its
+    # config provenance (config_source tuned|default + tune_key_hash)
+    # and the line carries the autotune_rows section
+    # (tests/test_autotune.py pins the bench_diff category)
+    assert bench.METRIC_VERSION == 11
+    monkeypatch.setattr(bench, "_autotune_rows",
+                        lambda host_only=False: {})
     monkeypatch.setattr(bench, "_degraded_rows",
                         lambda iterations, host_only=False: {})
     monkeypatch.setattr(bench, "_serving_rows",
@@ -279,6 +282,10 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
                         lambda host_only=False: {})
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["metric_version"] == bench.METRIC_VERSION
+    # metric_version 11: the autotune rows ride the error line too
+    # (host-only analytic sweep — the tunnel-down tuning path)
+    assert "autotune_rows" in err
+    assert dict(bench.AUTOTUNE_ROWS)  # at least one declared row
     # metric_version 10: the device-chaos rows + the supervisor blob
     # ride the error line too (a tunnel-down round records what the
     # supervised plane did about it)
@@ -320,9 +327,12 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     row = bench._row_result({"gbps": 1.23456789, "lat_p50_ms": 0.5,
                              "lat_p99_ms": 0.9, "lat_p999_ms": 1.0,
                              "lat_samples": 7})
+    # metric_version 11: every row carries its config provenance
+    # (absent fields default to the hand-picked-constants regime)
     assert row == {"gbps": 1.2346, "lat_p50_ms": 0.5,
                    "lat_p99_ms": 0.9, "lat_p999_ms": 1.0,
-                   "lat_samples": 7}
+                   "lat_samples": 7, "config_source": "default",
+                   "tune_key_hash": None}
     # the official decode rows route shec through the packed slice
     # chain and clay through packed carry (MXU composites are not
     # DCE-opaque, so slice would be fiction there)

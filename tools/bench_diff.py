@@ -20,9 +20,11 @@ makes it a gate:
    ``scenario:<row>`` (GB/s-under-SLO *under contention* — the
    p99-under-contention gate of ISSUE 11),
    ``device_chaos:<row>`` (recovery-under-fault GB/s through the
-   supervised dispatch plane — ISSUE 13), ``profile:<row>``.
+   supervised dispatch plane — ISSUE 13), ``profile:<row>``,
+   ``autotune:<row>`` (the tuner's best after-utilization-% — a tuned
+   config that later regresses fails CI, ISSUE 14).
    Ratios/latency rows are deliberately excluded — one sentinel, one
-   direction.
+   direction (utilization-% is higher-is-better like GB/s).
 3. **Diff with per-row noise floors** — the CURRENT record (BENCH_
    LAST_GOOD.json, or ``--candidate <file>`` for a fresh bench line)
    regresses a row when it falls below the best prior value by more
@@ -79,6 +81,12 @@ FLOORS: Dict[str, float] = {
     # pattern cache) must still trip the sentinel
     "device_chaos": 0.55,
     "profile": 0.60,
+    # the autotune rows track the tuner's best after-utilization-%:
+    # modeled (analytic) rows are deterministic, timed rows swing
+    # with scheduler load like the other host-clocked categories — a
+    # tuned config silently regressing to the default's utilization
+    # must still trip the sentinel (ISSUE 14)
+    "autotune": 0.50,
 }
 
 
@@ -121,6 +129,18 @@ def extract_series(rec: dict) -> Dict[str, float]:
                     # comparisons stay well-defined
                     rcat = "composite_decode"
                 series[f"{rcat}:{name}"] = g
+    # autotune rows (ISSUE 14): the tuner's best after-utilization-%
+    # is the series — higher is better, and unlike this row's gbps
+    # (sweep wall-time bookkeeping) it is what the tuner optimizes
+    body = rec.get("autotune_rows")
+    if isinstance(body, dict):
+        for name, row in sorted(body.items()):
+            if not isinstance(row, dict):
+                continue
+            u = row.get("utilization_pct")
+            if isinstance(u, (int, float)) and not isinstance(u, bool) \
+                    and u > 0:
+                series[f"autotune:{name}"] = float(u)
     # serving + scenario rows: GB/s-under-SLO is the series (raw
     # gbps as the fallback for rows predating the field)
     for section, cat in (("serving_rows", "serving"),

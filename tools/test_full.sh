@@ -59,6 +59,14 @@ python tools/perf_dump.py --scenario unrecoverable --fake-clock \
     || { echo "perf_dump: flight-recorder gate failed"; exit 1; }
 python tools/bench_diff.py \
     || { echo "bench_diff: perf regression against the BENCH_* trajectory"; exit 1; }
+# Autotune gate (ISSUE 14 / docs/PERF.md "Roofline-closing
+# autotuner"): the host-only analytic sweep must run with zero jax
+# compiles, emit a schema-valid best-config table that round-trips,
+# and be byte-identical across two runs from one seed — the mode
+# tunnel-down rounds (and the tune.sweep audit entry) rely on.
+python tools/autotune.py --analytic --out /tmp/ceph_tpu_tune_smoke.json \
+    --validate >/dev/null \
+    || { echo "autotune: analytic smoke gate failed"; exit 1; }
 # Serving gate (ISSUE 7 / docs/SERVING.md): the seeded mixed
 # rs/shec/clay stream with the chaos-degraded repair slice must serve
 # byte-identical under a schema-valid telemetry dump (rc 0), and an
