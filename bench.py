@@ -139,7 +139,14 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # blob (the process supervisor's cumulative counters + demotion
 # state), so a round artifact shows whether the run survived device
 # faults and on which tier it finished.
-METRIC_VERSION = 11
+# v12 (ISSUE 15, causal tracing plane): the serving_rows and
+# scenario_rows carry a `tail_attribution` blob — the per-segment
+# share of p99 time (queue_wait / batch_wait / arbiter_hold /
+# retry_backoff / device_dispatch / demux, telemetry/analyzer.py)
+# plus the dominant segment — on success AND the host-only error
+# lines, so a tail number that moves names which seam moved it
+# (docs/OBSERVABILITY.md "Causal tracing & tail attribution").
+METRIC_VERSION = 12
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -394,7 +401,7 @@ SCENARIO_ROW_FIELDS = (
     "qos_scale_min", "qos_burn_trips", "slo_burn_trips",
     "recovery_rounds", "recovery_ops_completed", "churn_events",
     "straggler_reassignments", "rateless_p99_ratio",
-    "stream_compiles", "requests", "verified")
+    "stream_compiles", "requests", "verified", "tail_attribution")
 
 
 def _scenario_rows(host_only: bool = False,
@@ -498,7 +505,7 @@ def _serving_rows(host_only: bool = False, requests: int | None = None
             row = _row_result(res)
             for f in ("gbps_under_slo", "deadline_miss_rate",
                       "padding_overhead", "requests", "rejected",
-                      "stream_compiles"):
+                      "stream_compiles", "tail_attribution"):
                 row[f] = res.get(f)
             rows[name] = row
         except Exception as e:  # noqa: BLE001 - recorded, never fatal

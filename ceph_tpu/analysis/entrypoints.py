@@ -777,6 +777,19 @@ def _build_tune_sweep() -> Built:
     return Built(tune_sweep_selftest, (), tune_sweep_selftest)
 
 
+def _build_tracing_selftest() -> Built:
+    """The causal tracing plane as a host-tier entry (ISSUE 15): a
+    seeded FakeClock mini-scenario through the REAL serving seams
+    with a collector installed, decomposed by the analyzer (segment
+    sums exact), both exports rendered and schema-validated — ZERO
+    jax compiles, zero device arrays, forever.  A tracing plane that
+    pulled work onto the device would distort exactly the tails it
+    exists to attribute."""
+    from ..telemetry.tracing import tracing_selftest
+
+    return Built(tracing_selftest, (), tracing_selftest)
+
+
 def _build_scenario_qos() -> Built:
     """The mClock arbiter as a host-tier entry (ISSUE 11):
     reservation floor, weight pacing, limit ceiling and burn-rate
@@ -886,6 +899,14 @@ def registry() -> Tuple[EntryPoint, ...]:
                    trace_budget=0),
         EntryPoint("telemetry.flight_recorder", "telemetry", "host",
                    _build_flight_recorder, allow=None, trace_budget=0),
+        # the causal tracing plane (ISSUE 15): trace mint/propagation,
+        # segment decomposition and both exports are host bookkeeping
+        # forever — 0 compiles, 0 device arrays (its only device
+        # adjacency is READING the profiler series name at the
+        # already-audited engine seams)
+        EntryPoint("telemetry.tracing", "telemetry", "host",
+                   _build_tracing_selftest, allow=None,
+                   trace_budget=0),
         EntryPoint("serve.dispatch", "serve", "jit",
                    _build_serve_dispatch, allow=GF_XLA_PRIMS,
                    trace_budget=16),

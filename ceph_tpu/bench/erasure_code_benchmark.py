@@ -1014,6 +1014,25 @@ class ErasureCodeBench:
         res["device_calls"] = rep.device_calls
         return res
 
+    def _run_traced(self, run_fn):
+        """Run one serving/scenario measurement under a fresh causal-
+        tracing collector (telemetry/tracing.py) and return
+        ``(result, tail_attribution)`` — the metric_version 12 blob:
+        per-segment share of p99 time across all op classes
+        (telemetry/analyzer.py::tail_shares), so a serving number
+        that moves names which segment moved it.  Works identically
+        on the host-only error path (the seams are host bookkeeping);
+        the previous collector (if any) is restored."""
+        from ..telemetry import analyzer, tracing
+        coll = tracing.TraceCollector(seed=self.args.seed)
+        prev = tracing.install(coll)
+        try:
+            out = run_fn()
+        finally:
+            tracing.install(prev)
+        rows = analyzer.decompose_all(coll.to_dict())
+        return out, analyzer.tail_shares(rows, "p99")
+
     # -- serving (the ragged continuous-batching front-end: a seeded
     # mixed request stream through serve/ — ROADMAP item 3) -------------
 
@@ -1036,7 +1055,8 @@ class ErasureCodeBench:
                             stripe_size=a.size, erasures=a.erasures,
                             arrival="closed")
         spec.concurrency = a.concurrency
-        run = run_serving_scenario(spec, executor=executor)
+        run, tail = self._run_traced(
+            lambda: run_serving_scenario(spec, executor=executor))
         bad = verify_results(run.results)
         if bad:
             raise RuntimeError(
@@ -1056,6 +1076,7 @@ class ErasureCodeBench:
         res["dispatches"] = rep["padding"]["dispatches"]
         res["stream_compiles"] = rep.get("stream_compiles")
         res["op_classes"] = rep["op_classes"]
+        res["tail_attribution"] = tail
         return res
 
     # -- multichip (the mesh data plane: encode fanned out across the
@@ -1253,10 +1274,12 @@ class ErasureCodeBench:
         (scenario/qos.py; --no-arbiter is the unthrottled control).
         The contention axes — GB/s-under-SLO, p99,
         deadline-miss-rate — are what tools/bench_diff.py's
-        ``scenario`` category gates.  Correctness gates run
-        in-workload: client stream byte-verified against ground
-        truth, recovery converged with byte-identical heal, zero data
-        loss."""
+        ``scenario`` category gates; since metric_version 12 the row
+        also carries ``tail_attribution`` (per-segment share of p99
+        time from the causal tracing plane, telemetry/analyzer.py).
+        Correctness gates run in-workload: client stream
+        byte-verified against ground truth, recovery converged with
+        byte-identical heal, zero data loss."""
         from ..scenario import default_scenario, run_scenario
         a = self.args
         executor = "device" if a.device == "jax" else "host"
@@ -1265,8 +1288,9 @@ class ErasureCodeBench:
             damaged_objects=max(2, a.batch), erasures=a.erasures,
             storm_events=min(a.storm_events, 12),
             straggler_factor=a.slow_factor)
-        run = run_scenario(spec, executor=executor,
-                           enable_arbiter=not a.no_arbiter)
+        run, tail = self._run_traced(
+            lambda: run_scenario(spec, executor=executor,
+                                 enable_arbiter=not a.no_arbiter))
         rep = run.report
         if not rep.ok():
             raise RuntimeError(f"scenario gates failed: {rep.gates}")
@@ -1290,6 +1314,7 @@ class ErasureCodeBench:
             rep.rateless["straggler_reassignments"]
         res["rateless_p99_ratio"] = rep.rateless["p99_ratio"]
         res["stream_compiles"] = rep.slo.get("stream_compiles")
+        res["tail_attribution"] = tail
         res["verified"] = True
         return res
 

@@ -50,6 +50,7 @@ from collections import OrderedDict
 from typing import Callable, Optional, Tuple
 
 from ..telemetry import metrics as tel
+from ..telemetry import tracing as trc
 from ..utils.log import dout
 
 DEFAULT_MAX_PATTERNS = 512
@@ -364,6 +365,13 @@ def fused_repair_call(ec, available: Tuple[int, ...],
                              batch=int(stack.shape[0]), **prof_labels)
             else:
                 pk = prof_key
+            if eager and trc.enabled():
+                # causal-trace link (ISSUE 15): name the EXACT
+                # profiler series this dispatch rides, so a trace's
+                # program event joins attribution_rows() per-trace
+                trc.note_program(
+                    "engine.fused_repair",
+                    dict(prof_labels, batch=int(stack.shape[0])))
             with tel.record_dispatch(
                     "engine_fused_repair_dispatch",
                     eager=eager, plugin=type(ec).__name__), \
@@ -482,6 +490,11 @@ def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
                              batch=int(stack.shape[0]), **prof_labels)
             else:
                 pk = prof_key
+            if eager and trc.enabled():
+                # causal-trace link (ISSUE 15): see fused_repair_call
+                trc.note_program(
+                    "engine.serve_dispatch",
+                    dict(prof_labels, batch=int(stack.shape[0])))
             with tel.record_dispatch(
                     "serve_dispatch", eager=eager,
                     op=op, plugin=type(ec).__name__), \

@@ -346,15 +346,24 @@ class DispatchSupervisor:
 
     def _run_with_retry(self, seam, fn, args, plan):
         from ..telemetry import metrics as tel
+        from ..telemetry import tracing
 
         def once():
             fault = plan.poll(seam) if plan is not None else None
             return self._call_once(seam, fn, args, fault, plan)
 
-        def on_retry(_i, _d, e):
+        def on_retry(_i, delay, e):
             self._count("retries")
             tel.counter("supervisor_retries", seam=seam,
                         error=type(e).__name__)
+            if tracing.enabled():
+                # on_retry fires BEFORE the backoff sleep, so the
+                # interval [now, now+delay] is exactly the clock time
+                # this dispatch spent backing off — the analyzer's
+                # retry_backoff carve (telemetry/analyzer.py)
+                now = self.clock.monotonic()
+                tracing.note_retry(seam, now, now + delay,
+                                   error=type(e).__name__)
 
         return retry_call(once, policy=self.retry_policy,
                           clock=self.clock, on_retry=on_retry)
@@ -413,9 +422,14 @@ class DispatchSupervisor:
     def _split_redispatch(self, seam, fn, args, *, host_fn, rebuild,
                           verifiable, depth):
         from ..telemetry import metrics as tel
+        from ..telemetry import tracing
         stack = args[0]
         b = int(stack.shape[0])
         mid = (b + 1) // 2
+        if tracing.enabled():
+            tracing.annotate("supervisor_rung_downshift",
+                             self.clock.monotonic(), seam=seam,
+                             batch=b, split=f"{mid}+{b - mid}")
         self._count("rung_downshifts")
         tel.counter("supervisor_rung_downshifts", seam=seam)
         tel.event("supervisor_rung_downshift", seam=seam, batch=b,
@@ -447,7 +461,11 @@ class DispatchSupervisor:
     def _quarantine(self, seam, p, rebuild):
         from ..parallel import plane as planemod
         from ..telemetry import metrics as tel
-        from ..telemetry import recorder
+        from ..telemetry import recorder, tracing
+        if tracing.enabled():
+            tracing.annotate("supervisor_quarantine",
+                             self.clock.monotonic(), seam=seam,
+                             from_devices=p.n_devices)
         n = p.n_devices
         if self._plane_width0 is None:
             self._plane_width0 = n
@@ -486,6 +504,12 @@ class DispatchSupervisor:
         self._tier_demotions += 1
         if to == "numpy":
             self._floor = "numpy"
+        from ..telemetry import tracing
+        if tracing.enabled():
+            tracing.annotate("supervisor_demote",
+                             self.clock.monotonic(), seam=seam,
+                             frm=cur, to=to,
+                             error=type(err).__name__)
         self._count("demotions")
         tel.counter("supervisor_demotions", seam=seam, to=to)
         tel.event("supervisor_demote", seam=seam, frm=cur, to=to,
@@ -621,6 +645,12 @@ class DispatchSupervisor:
         self._floor = None
         self._clean_probes = 0
         self._cache_clear()
+        from ..telemetry import tracing
+        if tracing.enabled():
+            tracing.annotate("supervisor_repromote",
+                             self.clock.monotonic(),
+                             tier=restored or "",
+                             plane_width=width0 or 0)
         self._count("repromotions")
         tel.counter("supervisor_repromotions")
         tel.event("supervisor_repromote", tier=restored,

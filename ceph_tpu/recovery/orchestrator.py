@@ -45,6 +45,7 @@ from ..crush.types import CRUSH_ITEM_NONE
 from ..scrub.deep_scrub import deep_scrub, repair_batched, \
     unrecoverable_extents
 from ..telemetry import metrics as tel
+from ..telemetry import tracing
 from ..telemetry.spans import global_tracer
 from ..utils.errors import InjectedCrash
 from ..utils.log import dout
@@ -445,6 +446,22 @@ class RecoveryOrchestrator:
         if r.rounds >= self.max_rounds:
             return len(ops)
         r.rounds += 1
+        # causal trace (ISSUE 15): each executed recovery round is a
+        # background trace naming the objects it touched, so a client
+        # tail sample's arbiter_hold joins back to the exact round —
+        # and its objects — that charged the shared clock
+        rtrace = None
+        if tracing.enabled():
+            rtrace = tracing.active().begin(
+                "recovery", op="repair",
+                plugin=type(self.ec).__name__)
+            if rtrace is not None:
+                rtrace.add("round_start", self.clock.monotonic(),
+                           round=r.rounds,
+                           epoch=get_epoch(self.osdmap),
+                           objects=sorted({op.obj for op in ops}),
+                           ops=len(ops))
+        completed_before = r.ops_completed
         with tracer.span("round", round=r.rounds):
             with tracer.span("decode", ops=len(ops)):
                 payloads = self._decode(ops)
@@ -454,6 +471,11 @@ class RecoveryOrchestrator:
         r.epoch_end = get_epoch(self.osdmap)
         if self.round_delay:
             self.clock.sleep(self.round_delay)
+        if rtrace is not None:
+            rtrace.add("round_end", self.clock.monotonic(),
+                       completed=r.ops_completed - completed_before,
+                       replans=r.replans, regroups=r.regroups,
+                       fence_deferrals=r.fence_deferrals)
         return len(ops)
 
     def run(self) -> RecoveryReport:

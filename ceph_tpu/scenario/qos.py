@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..telemetry import metrics as tel
+from ..telemetry import tracing
 
 CLIENT = "client"
 BACKGROUND = ("recovery", "scrub", "rebalance")
@@ -154,30 +155,46 @@ class MClockArbiter:
         if st.r_tag is None:
             st.r_tag = st.p_tag = st.l_tag = now
         if limit > 0 and st.l_tag > now:
-            return self._deny(cls, st, "limit")
+            return self._deny(cls, st, "limit", now, scale)
         if res > 0 and st.r_tag <= now:
             st.r_tag = max(st.r_tag, now) + 1.0 / res
             st.reservation_grants += 1
             return self._grant(cls, st, now, rate, limit,
-                               phase="reservation")
+                               phase="reservation", scale=scale)
         if rate > 0 and st.p_tag <= now:
             return self._grant(cls, st, now, rate, limit,
-                               phase="weight")
-        return self._deny(cls, st, "weight")
+                               phase="weight", scale=scale)
+        return self._deny(cls, st, "weight", now, scale)
 
     def _grant(self, cls: str, st: _ClassState, now: float,
-               rate: float, limit: float, phase: str) -> bool:
+               rate: float, limit: float, phase: str,
+               scale: float = 1.0) -> bool:
         if rate > 0:
             st.p_tag = max(st.p_tag, now) + 1.0 / rate
         if limit > 0:
             st.l_tag = max(st.l_tag, now) + 1.0 / limit
         st.grants += 1
         tel.counter("qos_grants", cls=cls, phase=phase)
+        if tracing.enabled():
+            # causal trace (ISSUE 15): every background decision with
+            # the arbiter's pressure + background scale AT decision
+            # time — a tail sample's arbiter_hold names its cause
+            c = tracing.active()
+            c.add_qos(cls, True, phase, now,
+                      pressure=self.pressure(), scale=scale)
         return True
 
-    def _deny(self, cls: str, st: _ClassState, reason: str) -> bool:
+    def _deny(self, cls: str, st: _ClassState, reason: str,
+              now: Optional[float] = None,
+              scale: float = 1.0) -> bool:
         st.denials[reason] = st.denials.get(reason, 0) + 1
         tel.counter("qos_denials", cls=cls, reason=reason)
+        if tracing.enabled():
+            c = tracing.active()
+            c.add_qos(cls, False, reason,
+                      now if now is not None
+                      else self.clock.monotonic(),
+                      pressure=self.pressure(), scale=scale)
         return False
 
     def hold_for(self, cls: str, now: Optional[float] = None) -> float:

@@ -42,6 +42,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..telemetry import metrics as tel
+from ..telemetry import tracing
 
 # advance floor when the sim clock would otherwise stall (a due event
 # exactly at `now` always makes progress on the next poll)
@@ -92,6 +93,10 @@ def run_serving_scenario(spec, clock=None, executor: str = "device",
 
     if clock is None:
         clock = SystemClock()
+    # the CEPH_TPU_TRACE opt-in: a causal-trace collector for this
+    # run when the env knob asks and none is active (no-op otherwise;
+    # tracing is off by default — docs/OBSERVABILITY.md)
+    tracing.maybe_install_from_env(clock=clock, seed=spec.seed)
     if requests is not None:
         reqs = requests
         if spec.arrival == "open" and offsets is None:
@@ -395,6 +400,7 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
 
     if clock is None:
         clock = SystemClock()
+    tracing.maybe_install_from_env(clock=clock, seed=spec.seed)
     sim = service_model is not None
     chaos = spec.chaos
     t_start = clock.monotonic()
@@ -496,7 +502,19 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
         arbiter.record_client(res.deadline_met)
         throttle.set_scale(arbiter.background_scale())
 
+    def _charge(cls: str, t0: float, **attrs) -> None:
+        # causal tracing (ISSUE 15): background work that aged waiting
+        # client requests on the shared clock is an attribution
+        # interval — the analyzer carves it out of queue/batch waits
+        # as `arbiter_hold`.  Observation only: clock reads, no sleeps.
+        if tracing.enabled():
+            tracing.active().add_background(
+                cls, t0, clock.monotonic(),
+                pressure=round(arbiter.pressure(), 6),
+                scale=round(arbiter.background_scale(), 6), **attrs)
+
     def run_recovery_round() -> None:
+        t0 = clock.monotonic()
         nops = orch.run_round()
         state["recovery_rounds"] += 1
         tel.counter("scenario_recovery_rounds")
@@ -504,6 +522,8 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
             state["converged"] = True
         elif sim and nops:
             clock.sleep(spec.recovery_round_s)
+        _charge("recovery", t0, round=state["recovery_rounds"],
+                ops=nops)
 
     def interleave() -> None:
         state["turns"] += 1
@@ -521,17 +541,22 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
                 tel.counter("scenario_churn_events")
                 if sim:
                     clock.sleep(spec.churn_step_s)
+                _charge("rebalance", now,
+                        event=state["churn_events"])
         if not state["converged"] and arbiter.admit("recovery"):
             run_recovery_round()
         if (state["scrub_ticks"] < chaos.scrub_ticks
                 and arbiter.admit("scrub")):
             i = state["scrub_idx"] % len(stores)
             state["scrub_idx"] += 1
+            t0 = clock.monotonic()
             deep_scrub(sinfo, ec, stores[i], hinfos[i])
             state["scrub_ticks"] += 1
             tel.counter("scenario_scrub_ticks")
             if sim:
                 clock.sleep(spec.scrub_tick_s)
+            _charge("scrub", t0, tick=state["scrub_ticks"],
+                    object=i)
 
     # -- the client stream (with background interleaved) -----------------
     from ..serve.sla import SlaRecorder, SloPolicy

@@ -45,6 +45,7 @@ import numpy as np
 
 from ..telemetry import metrics as tel
 from ..telemetry import span
+from ..telemetry import tracing
 from ..utils.log import dout
 from .queue import AdmissionQueue, EcRequest, EcResult
 
@@ -222,6 +223,13 @@ class ContinuousBatcher:
                     f"{tuple(req.payload.shape)} != {want} for "
                     f"op={req.op} plugin={req.plugin}")
             b.requests.append(req)
+            if req.trace is not None:
+                # the request→bucket link: bucket identity ≡ device-
+                # program identity, so the trace names the program
+                # family it will ride before the batch even fires
+                req.trace.add("bucket", self.clock.monotonic(),
+                              bucket="|".join(str(p) for p in b.key),
+                              pending=len(b.requests))
             if len(b.requests) >= self.ladder[-1]:
                 results += self._fire(b)
         return results
@@ -298,7 +306,14 @@ class ContinuousBatcher:
                 rec, parity = out
                 return np.asarray(rec), np.asarray(parity)
             return np.asarray(out)
-        # host tier: numpy end to end
+        # host tier: numpy end to end (the trace still names the
+        # program family it rode — "host:" tier, so a host-executor
+        # trace joins nothing in attribution_rows but stays honest
+        # about where the bytes were computed)
+        if tracing.enabled():
+            tracing.note_program(
+                "serve.host", {"op": b.op,
+                               "plugin": type(b.ec).__name__})
         if b.op == "encode":
             return np.asarray(b.ec.encode_chunks_batch(stack))
         if b.op == "decode":
@@ -313,6 +328,10 @@ class ContinuousBatcher:
         stack = np.zeros((rung, b.rows, b.chunk_size), np.uint8)
         for i, r in enumerate(reqs):
             stack[i] = r.payload
+        traced = (tracing.enabled()
+                  and any(r.trace is not None for r in reqs))
+        if traced:
+            tracing.clear_program()
         t0 = self.clock.monotonic()
         with span("serve.batch", op=b.op, occupancy=n, rung=rung,
                   plugin=type(b.ec).__name__):
@@ -356,6 +375,26 @@ class ContinuousBatcher:
                 queue_wait=max(0.0, wait), service=service,
                 batch_occupancy=n, batch_rung=rung,
                 deadline_met=(r.deadline is None or t1 <= r.deadline)))
+        if traced:
+            # the fire decision + the program the batch rode + the
+            # per-request demux completion, stamped on the SAME clock
+            # as the SLO ledger (on a FakeClock t_done == t1 — demux
+            # is host bookkeeping, charged only on the real clock)
+            program = tracing.take_program()
+            batch_seq = self.dispatches - 1
+            t_done = self.clock.monotonic()
+            for r, res in zip(reqs, results):
+                tr = r.trace
+                if tr is None:
+                    continue
+                tr.add("fire", t0, occupancy=n, rung=rung,
+                       batch_seq=batch_seq, executor=self.executor,
+                       co_batched=[q.req_id for q in reqs])
+                if program is not None:
+                    tr.add("program", t0, series=program)
+                tr.add("dispatch_end", t1)
+                tr.add("done", t_done,
+                       deadline_met=res.deadline_met)
         return results
 
     # -- warmup ----------------------------------------------------------

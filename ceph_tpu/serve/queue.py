@@ -35,6 +35,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..telemetry import metrics as tel
+from ..telemetry import tracing
 
 OPS = ("encode", "decode", "repair")
 
@@ -76,6 +77,10 @@ class EcRequest:
     # ground truth for --validate paths (demo/tests only; the server
     # never reads it)
     expect: object = None
+    # causal-trace context (telemetry/tracing.py), minted at admission
+    # when a collector is installed AND the deterministic sampling
+    # draw passes; None otherwise — every downstream hook gates on it
+    trace: object = None
 
     def __post_init__(self) -> None:
         if self.op not in OPS:
@@ -146,7 +151,12 @@ class AdmissionQueue:
             self.admitted += 1
             tel.counter("serve_admitted", op=req.op)
             tel.gauge("serve_queue_depth", len(self._pending))
-            return True
+        # causal trace minted AT admission (outside the queue lock —
+        # minting is collector bookkeeping): the trace's first event
+        # is the same `arrival` stamp the SLO ledger measures from
+        if tracing.enabled():
+            tracing.mint(req)
+        return True
 
     def drain(self) -> List[EcRequest]:
         """Pop everything pending, arrival order (the batcher calls

@@ -168,7 +168,14 @@ class SlaRecorder:
             self._wait[op] = LatencyHistogram()
             self.count[op] = self.misses[op] = 0
             self.ok_bytes[op] = self.total_bytes[op] = 0
-        h.record(result.latency)
+        # exemplar link (ISSUE 15): a traced request's latency sample
+        # carries its trace id, so the report's (and any flight-
+        # recorder dump's) p99+ exemplars point straight at the causal
+        # trace that explains them.  With tracing off, trace is None
+        # and the histograms dump byte-identically to before.
+        trace = getattr(result.request, "trace", None)
+        tid = trace.trace_id if trace is not None else None
+        h.record(result.latency, exemplar=tid)
         self._wait[op].record(result.queue_wait)
         self.count[op] += 1
         self.total_bytes[op] += result.request.work_bytes
@@ -178,7 +185,8 @@ class SlaRecorder:
             self.misses[op] += 1
             tel.counter("serve_deadline_miss", op=op)
         # mirror into the unified metrics plane (perf dump / prom)
-        tel.observe("serve_request_seconds", result.latency, op=op)
+        tel.observe("serve_request_seconds", result.latency,
+                    exemplar=tid, op=op)
 
     # -- readout ---------------------------------------------------------
 
@@ -211,6 +219,14 @@ class SlaRecorder:
                 **self._pcts(self._hist.get(op)),
                 "queue_wait": self._pcts(self._wait.get(op)),
             }
+            exemplars = self._hist[op].exemplars()
+            if exemplars:
+                # top-quantile samples with their trace ids (only
+                # traced runs capture any — the report shape is
+                # unchanged otherwise)
+                per_op[op]["p99_exemplars"] = [
+                    {"latency_ms": round(e["value"] * 1e3, 6),
+                     "trace_id": e["trace_id"]} for e in exemplars]
             target = self.policy.p99_targets.get(op)
             if target is not None:
                 p99 = per_op[op]["p99_ms"]

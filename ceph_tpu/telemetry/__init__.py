@@ -58,6 +58,7 @@ from .schema import (
     validate_dump,
     validate_flight_dump,
     validate_profile_section,
+    validate_trace_dump,
 )
 from .spans import (
     Span,
@@ -66,9 +67,15 @@ from .spans import (
     set_global_tracer,
     span,
 )
+from .tracing import (
+    TraceCollector,
+    TraceContext,
+    tracing_selftest,
+)
 
 
-def dump_all(profile: bool = False, flight: bool = False) -> dict:
+def dump_all(profile: bool = False, flight: bool = False,
+             traces: bool = False) -> dict:
     """The unified observability dump: the legacy perf-counter
     registry (utils/perf.py, the reference's `perf dump` shape), the
     telemetry metrics registry, and the finished span trees — one
@@ -76,7 +83,9 @@ def dump_all(profile: bool = False, flight: bool = False) -> dict:
 
     ``profile`` adds the device-plane profiler's attribution section
     (whatever programs the process has captured so far); ``flight``
-    adds the flight recorder's ring + post-mortem dumps."""
+    adds the flight recorder's ring + post-mortem dumps; ``traces``
+    adds the causal-tracing collector's dump (empty-shaped when no
+    collector is installed)."""
     from ..utils.perf import global_perf
 
     out: dict = {"schema_version": SCHEMA_VERSION}
@@ -87,6 +96,11 @@ def dump_all(profile: bool = False, flight: bool = False) -> dict:
         out["profile"] = global_profiler().to_dict()
     if flight:
         out["flight_recorder"] = global_flight_recorder().to_dict()
+    if traces:
+        from . import tracing as _tracing
+        coll = _tracing.active()
+        out["traces"] = (coll.to_dict() if coll is not None
+                         else _tracing.TraceCollector().to_dict())
     return out
 
 
@@ -157,6 +171,8 @@ __all__ = [
     "SCHEMA_VERSION",
     "Span",
     "SpanTracer",
+    "TraceCollector",
+    "TraceContext",
     "bucket_index",
     "bucket_lower",
     "counter",
@@ -182,7 +198,9 @@ __all__ = [
     "set_global_tracer",
     "span",
     "telemetry_selftest",
+    "tracing_selftest",
     "validate_dump",
     "validate_flight_dump",
     "validate_profile_section",
+    "validate_trace_dump",
 ]

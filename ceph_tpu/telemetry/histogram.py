@@ -29,13 +29,36 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 # linear sub-buckets per power-of-two octave: relative resolution 1/64
 SUB = 64
 # frexp exponent bias so indexes stay non-negative for every positive
 # double (frexp exponents reach -1073 for subnormals)
 _EXP_BIAS = 1100
+
+# default exemplar capacity for NEW histograms (ISSUE 15): 0 = off.
+# The tracing plane raises this while a collector is installed
+# (telemetry/tracing.py::install), so SLO/latency histograms created
+# during a traced run retain their top-quantile exemplars — p99+
+# samples in SLO reports and flight-recorder dumps then link straight
+# to their traces.
+_default_exemplars = 0
+
+
+def default_exemplars() -> int:
+    return _default_exemplars
+
+
+def set_default_exemplars(n: int) -> int:
+    """Set the exemplar capacity new histograms are born with;
+    returns the previous value.  Existing histograms are unaffected
+    (capacity is fixed at construction — a dump's shape never changes
+    under a live histogram)."""
+    global _default_exemplars
+    prev = _default_exemplars
+    _default_exemplars = max(0, int(n))
+    return prev
 
 
 def bucket_index(value: float) -> int:
@@ -59,7 +82,7 @@ class LatencyHistogram:
     """Sparse log-bucketed histogram over non-negative floats
     (seconds by convention; the unit is the caller's contract)."""
 
-    def __init__(self) -> None:
+    def __init__(self, exemplars: Optional[int] = None) -> None:
         self._lock = threading.Lock()
         self._buckets: Dict[int, int] = {}
         self._zeros = 0
@@ -67,8 +90,18 @@ class LatencyHistogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # bounded top-quantile exemplars (value, insertion seq, id):
+        # the largest `exemplar_capacity` recordings that carried an
+        # exemplar id — deterministic (seq breaks value ties), so a
+        # seeded run dumps byte-identical exemplar lists
+        self.exemplar_capacity = (_default_exemplars
+                                  if exemplars is None
+                                  else max(0, int(exemplars)))
+        self._exemplars: List[Tuple[float, int, str]] = []
+        self._exemplar_seq = 0
 
-    def record(self, value: float) -> None:
+    def record(self, value: float, exemplar: Optional[str] = None
+               ) -> None:
         if value < 0:
             raise ValueError(f"latency {value} must be >= 0")
         with self._lock:
@@ -83,6 +116,26 @@ class LatencyHistogram:
             else:
                 idx = bucket_index(value)
                 self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            if exemplar is not None and self.exemplar_capacity:
+                self._exemplar_seq += 1
+                self._note_exemplar(value, self._exemplar_seq,
+                                    str(exemplar))
+
+    def _note_exemplar(self, value: float, seq: int,
+                       ident: str) -> None:
+        """Keep the top-capacity exemplars by (value, seq) — the
+        newest wins a value tie, so the retained set is a pure
+        function of the recording order."""
+        ex = self._exemplars
+        ex.append((value, seq, ident))
+        ex.sort(key=lambda e: (-e[0], -e[1]))
+        del ex[self.exemplar_capacity:]
+
+    def exemplars(self) -> List[dict]:
+        """The retained top-quantile exemplars, largest first."""
+        with self._lock:
+            return [{"value": v, "trace_id": i}
+                    for v, _s, i in self._exemplars]
 
     def quantile(self, p: float) -> Optional[float]:
         """See the module docstring for the exact semantics."""
@@ -122,8 +175,15 @@ class LatencyHistogram:
                 buckets = {"zero": self._zeros, **buckets}
             base = {"count": self.count, "sum": self.sum,
                     "min": self.min, "max": self.max}
+            exemplars = [{"value": v, "trace_id": i}
+                         for v, _s, i in self._exemplars]
         base.update(self.percentiles())
         base["buckets"] = buckets
+        if exemplars:
+            # only when captured: a capacity-0 (or exemplar-less)
+            # histogram dumps byte-identically to the pre-ISSUE-15
+            # shape, so every pinned fake-clock dump stays pinned
+            base["exemplars"] = exemplars
         return base
 
     def merge(self, other: "LatencyHistogram") -> None:
@@ -136,6 +196,7 @@ class LatencyHistogram:
             zeros = other._zeros
             count, total = other.count, other.sum
             omin, omax = other.min, other.max
+            oex = list(other._exemplars)
         with self._lock:
             for idx, c in buckets.items():
                 self._buckets[idx] = self._buckets.get(idx, 0) + c
@@ -146,6 +207,12 @@ class LatencyHistogram:
                 self.min = omin
             if omax is not None and (self.max is None or omax > self.max):
                 self.max = omax
+            if oex:
+                self.exemplar_capacity = max(self.exemplar_capacity,
+                                             other.exemplar_capacity)
+                for v, _s, i in oex:
+                    self._exemplar_seq += 1
+                    self._note_exemplar(v, self._exemplar_seq, i)
 
     def reset(self) -> None:
         with self._lock:
@@ -155,6 +222,9 @@ class LatencyHistogram:
             self.sum = 0.0
             self.min = None
             self.max = None
+            self._exemplars.clear()
+            self._exemplar_seq = 0
 
 
-__all__ = ["SUB", "LatencyHistogram", "bucket_index", "bucket_lower"]
+__all__ = ["SUB", "LatencyHistogram", "bucket_index", "bucket_lower",
+           "default_exemplars", "set_default_exemplars"]

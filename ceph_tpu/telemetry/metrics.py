@@ -114,8 +114,14 @@ class MetricsRegistry:
                 hist = self._hists[key] = LatencyHistogram()
             return hist
 
-    def observe(self, name: str, value: float, **labels) -> None:
-        self.histogram(name, **labels).record(value)
+    def observe(self, name: str, value: float,
+                exemplar: Optional[str] = None, **labels) -> None:
+        """``exemplar`` (ISSUE 15): an optional trace id retained by
+        the series' bounded top-quantile exemplar set when the
+        histogram was created with exemplar capacity (the tracing
+        plane raises the default while a collector is installed) —
+        p99+ samples in dumps then link straight to their traces."""
+        self.histogram(name, **labels).record(value, exemplar=exemplar)
 
     def event(self, kind: str, **fields) -> None:
         """Structured event stream (bounded; the log-once paths emit
@@ -286,9 +292,11 @@ def gauge(name: str, value: float, **labels) -> None:
         global_metrics().gauge(name, value, **labels)
 
 
-def observe(name: str, value: float, **labels) -> None:
+def observe(name: str, value: float, exemplar: Optional[str] = None,
+            **labels) -> None:
     if _enabled:
-        global_metrics().observe(name, value, **labels)
+        global_metrics().observe(name, value, exemplar=exemplar,
+                                 **labels)
 
 
 def event(kind: str, **fields) -> None:

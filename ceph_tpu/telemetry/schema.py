@@ -16,8 +16,12 @@ from typing import List
 # v2 (ISSUE 10): optional `profile` (device-plane cost/roofline
 # attribution rows, telemetry/profiler.py) and `flight_recorder`
 # (post-mortem ring + dumps, telemetry/recorder.py) sections join the
-# dump; both validated below when present.
+# dump; both validated below when present.  ISSUE 15 adds an optional
+# `traces` section (the causal-tracing collector dump,
+# telemetry/tracing.py) carrying its OWN trace_schema_version —
+# validated by validate_trace_dump like the flight blobs.
 SCHEMA_VERSION = 2
+TRACE_SCHEMA_VERSION = 1
 
 _HIST_REQUIRED = ("count", "sum", "min", "max", "p50", "p99", "p999",
                   "buckets")
@@ -46,6 +50,13 @@ def _check_hist(path: str, v: dict, errors: List[str]) -> None:
         errors.append(f"{path}: histogram count must be int >= 0")
     if not isinstance(v.get("buckets", None), dict):
         errors.append(f"{path}: histogram buckets must be an object")
+    if "exemplars" in v:
+        ex = v["exemplars"]
+        if not isinstance(ex, list) or any(
+                not isinstance(e, dict) or "value" not in e
+                or "trace_id" not in e for e in ex):
+            errors.append(f"{path}: exemplars must be objects with "
+                          f"value+trace_id")
     if v.get("count"):
         for q in ("p50", "p99", "p999", "min", "max"):
             if not _is_num(v.get(q)):
@@ -146,6 +157,82 @@ def validate_flight_dump(blob) -> List[str]:
     return errors
 
 
+_TRACE_REQUIRED = ("trace_schema_version", "seed", "sample",
+                   "dropped", "traces", "background", "qos",
+                   "retries", "annotations")
+
+
+def _check_interval(path: str, iv, key: str,
+                    errors: List[str]) -> None:
+    if not isinstance(iv, dict) or key not in iv \
+            or "t0_ns" not in iv or "t1_ns" not in iv:
+        errors.append(f"{path}: interval must carry {key}+t0_ns+t1_ns")
+        return
+    if not isinstance(iv["t0_ns"], int) \
+            or not isinstance(iv["t1_ns"], int):
+        errors.append(f"{path}: interval stamps must be integer ns")
+    elif iv["t1_ns"] < iv["t0_ns"]:
+        errors.append(f"{path}: interval ends before it starts")
+
+
+def validate_trace_dump(dump) -> List[str]:
+    """Validate one causal-tracing collector dump
+    (telemetry/tracing.py::TraceCollector.to_dict shape): trace
+    events carry integer-ns non-decreasing stamps, intervals are
+    ordered, QoS decisions carry pressure/scale."""
+    errors: List[str] = []
+    if not isinstance(dump, dict):
+        return ["trace dump must be a JSON object"]
+    for k in _TRACE_REQUIRED:
+        if k not in dump:
+            errors.append(f"trace dump missing {k!r}")
+    if dump.get("trace_schema_version") != TRACE_SCHEMA_VERSION:
+        errors.append(f"trace_schema_version must be "
+                      f"{TRACE_SCHEMA_VERSION}")
+    traces = dump.get("traces", [])
+    if not isinstance(traces, list):
+        errors.append("traces must be a list")
+        traces = []
+    for i, t in enumerate(traces):
+        path = f"traces[{i}]"
+        if not isinstance(t, dict) or "trace_id" not in t \
+                or "kind" not in t or "events" not in t:
+            errors.append(f"{path}: trace must carry "
+                          f"trace_id+kind+events")
+            continue
+        if not isinstance(t["trace_id"], str) or not t["trace_id"]:
+            errors.append(f"{path}: trace_id must be a non-empty "
+                          f"string")
+        prev = None
+        for j, ev in enumerate(t.get("events", ())):
+            if not isinstance(ev, dict) or "name" not in ev \
+                    or "t_ns" not in ev:
+                errors.append(f"{path}.events[{j}]: event must carry "
+                              f"name+t_ns")
+                continue
+            if not isinstance(ev["t_ns"], int):
+                errors.append(f"{path}.events[{j}]: t_ns must be an "
+                              f"integer (ns)")
+                continue
+            if prev is not None and ev["t_ns"] < prev:
+                errors.append(f"{path}.events[{j}]: events must be "
+                              f"time-ordered")
+            prev = ev["t_ns"]
+    for i, iv in enumerate(dump.get("background", ())):
+        _check_interval(f"background[{i}]", iv, "cls", errors)
+    for i, iv in enumerate(dump.get("retries", ())):
+        _check_interval(f"retries[{i}]", iv, "seam", errors)
+    for i, dec in enumerate(dump.get("qos", ())):
+        if not isinstance(dec, dict) or "cls" not in dec \
+                or "granted" not in dec or "pressure" not in dec \
+                or "scale" not in dec or "t_ns" not in dec:
+            errors.append(f"qos[{i}]: decision must carry cls+granted"
+                          f"+pressure+scale+t_ns")
+    if not isinstance(dump.get("dropped", 0), int):
+        errors.append("dropped must be an int")
+    return errors
+
+
 def _check_flight_section(path: str, section,
                           errors: List[str]) -> None:
     if not isinstance(section, dict) or "dumps" not in section \
@@ -179,9 +266,12 @@ def validate_dump(dump: dict) -> List[str]:
     if "flight_recorder" in dump:
         _check_flight_section("flight_recorder",
                               dump["flight_recorder"], errors)
+    if "traces" in dump:
+        for e in validate_trace_dump(dump["traces"]):
+            errors.append(f"traces: {e}")
     registries = [k for k in dump
                   if k not in ("schema_version", "spans", "profile",
-                               "flight_recorder")]
+                               "flight_recorder", "traces")]
     if not registries:
         errors.append("dump carries no metric registries")
     for reg in registries:
@@ -201,5 +291,6 @@ def validate_dump(dump: dict) -> List[str]:
     return errors
 
 
-__all__ = ["SCHEMA_VERSION", "validate_dump", "validate_flight_dump",
-           "validate_profile_section"]
+__all__ = ["SCHEMA_VERSION", "TRACE_SCHEMA_VERSION", "validate_dump",
+           "validate_flight_dump", "validate_profile_section",
+           "validate_trace_dump"]

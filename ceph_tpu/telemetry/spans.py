@@ -42,6 +42,14 @@ from ..utils.log import dout
 
 SPAN_DEBUG_LEVEL = 20   # dout level for span enter/exit events
 
+# once-per-process marker for the root-eviction event (ISSUE 15
+# satellite): the bounded deque dropping oldest roots used to be
+# silent outside the local `dropped` field — now the FIRST eviction
+# emits a structured event (and every eviction counts
+# `telemetry_spans_dropped`), so a truncated span dump is visible in
+# the dump that truncated it
+_drop_event_sent = False
+
 
 class _SystemClock:
     def monotonic(self) -> float:
@@ -148,10 +156,14 @@ class SpanTracer:
             if stack:
                 stack[-1].children.append(sp)
             else:
+                evicted = False
                 with self._lock:
                     if len(self.finished) == self.finished.maxlen:
                         self.dropped += 1
+                        evicted = True
                     self.finished.append(sp)
+                if evicted:
+                    self._note_dropped()
                 if self.on_root is not None:
                     try:
                         self.on_root(sp)
@@ -159,6 +171,22 @@ class SpanTracer:
                         pass
             dout("telemetry", SPAN_DEBUG_LEVEL,
                  f"span- {path} dur={sp.duration:.6f}s")
+
+    def _note_dropped(self) -> None:
+        """Count every evicted root in the unified metrics plane and
+        emit the truncation event once per process — a span dump that
+        lost its oldest trees must say so (regression-tested in
+        tests/test_tracing.py)."""
+        global _drop_event_sent
+        from . import metrics as tel
+        tel.counter("telemetry_spans_dropped")
+        if not _drop_event_sent:
+            _drop_event_sent = True
+            tel.event("telemetry_spans_dropped",
+                      max_roots=self.finished.maxlen,
+                      detail="bounded root deque evicted its oldest "
+                             "span tree; older roots are missing "
+                             "from to_dict() dumps")
 
     def to_dict(self) -> dict:
         with self._lock:

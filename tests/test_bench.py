@@ -261,11 +261,16 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
+    # metric_version 12 (ISSUE 15): the serving and scenario rows
+    # carry the `tail_attribution` blob (per-segment share of p99
+    # time from the causal tracing plane — tests/test_tracing.py
+    # pins the blob shape on the workload result)
+    assert bench.METRIC_VERSION == 12
+    assert "tail_attribution" in bench.SCENARIO_ROW_FIELDS
     # metric_version 11 (ISSUE 14): every workload row carries its
     # config provenance (config_source tuned|default + tune_key_hash)
     # and the line carries the autotune_rows section
     # (tests/test_autotune.py pins the bench_diff category)
-    assert bench.METRIC_VERSION == 11
     monkeypatch.setattr(bench, "_autotune_rows",
                         lambda host_only=False: {})
     monkeypatch.setattr(bench, "_degraded_rows",

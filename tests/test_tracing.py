@@ -1,0 +1,454 @@
+"""Causal tracing plane tier-1 slice (ceph_tpu/telemetry/tracing.py +
+analyzer.py, docs/OBSERVABILITY.md "Causal tracing & tail
+attribution").
+
+The acceptance axes of ISSUE 15:
+
+- Segment decomposition sums EXACTLY (integer ns) to the measured
+  end-to-end latency for every completed request, across rs/shec/clay
+  and all three ops, and matches the SLO ledger's latency.
+- A seeded FakeClock production day exports byte-identically across
+  reruns (trace dump AND Chrome timeline).
+- The pinned contention scenario's p99 tail attribution names
+  arbiter_hold/batch_wait shares that shrink when the arbiter is
+  enabled vs the --no-arbiter control.
+- Sampling-gated and off by default: no collector ⇒ requests carry no
+  trace and nothing records; sample=0.0 ⇒ no client traces.
+- Trace schema red/green; exemplar capture; the spans bounded-deque
+  eviction counter (satellite); the telemetry.tracing host-tier audit
+  entry stays green.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ceph_tpu.scenario import default_scenario, run_scenario
+from ceph_tpu.serve.loadgen import (
+    CodecSpec,
+    TrafficSpec,
+    run_serving_scenario,
+    throughput_service_model,
+)
+from ceph_tpu.telemetry import analyzer, tracing
+from ceph_tpu.telemetry.schema import validate_trace_dump
+from ceph_tpu.telemetry.tracing import SEGMENTS, TraceCollector
+from ceph_tpu.utils.retry import FakeClock
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def no_collector():
+    """Guarantee a tracing-off baseline and restore whatever was
+    installed afterwards."""
+    prev = tracing.install(None)
+    yield
+    tracing.install(prev)
+
+
+def traced_scenario(seed=42, n_requests=96, enabled=None, sample=1.0):
+    clock = FakeClock()
+    coll = TraceCollector(clock=clock, seed=seed, sample=sample)
+    prev = tracing.install(coll)
+    try:
+        run = run_scenario(
+            default_scenario(seed=seed, n_requests=n_requests,
+                             damaged_objects=3, storm_events=4),
+            clock=clock, executor="host",
+            service_model=throughput_service_model(),
+            enable_arbiter=enabled)
+    finally:
+        tracing.install(prev)
+    return run, coll
+
+
+# ----------------------------------------------------------------------
+# byte-identical export
+
+def test_trace_export_byte_identical(no_collector):
+    """Same seed ⇒ the same trace dump and the same Chrome timeline,
+    byte for byte — trace ids are seeded, stamps ride the FakeClock."""
+    _, a = traced_scenario(seed=42, n_requests=64)
+    _, b = traced_scenario(seed=42, n_requests=64)
+    assert a.to_json() == b.to_json()
+    ca = json.dumps(analyzer.chrome_trace(a.to_dict()), sort_keys=True)
+    cb = json.dumps(analyzer.chrome_trace(b.to_dict()), sort_keys=True)
+    assert ca == cb
+    # a different seed is a different day (different trace ids too)
+    _, c = traced_scenario(seed=43, n_requests=64)
+    assert c.to_json() != a.to_json()
+    ids_a = {t.trace_id for t in a.traces}
+    ids_c = {t.trace_id for t in c.traces}
+    assert ids_a and ids_a.isdisjoint(ids_c)
+
+
+def test_trace_dump_schema_green(no_collector):
+    _, coll = traced_scenario(seed=7, n_requests=32)
+    dump = coll.to_dict()
+    assert validate_trace_dump(dump) == []
+    # qos decisions carry the arbiter's pressure/scale at decision
+    # time, background intervals their class
+    assert dump["qos"], "no QoS decisions recorded"
+    assert all(set(d) >= {"cls", "granted", "pressure", "scale",
+                          "t_ns"} for d in dump["qos"])
+    assert dump["background"], "no background charge intervals"
+    assert {iv["cls"] for iv in dump["background"]} >= {"recovery"}
+    # recovery rounds ride as background traces naming their objects
+    rec = [t for t in dump["traces"] if t["kind"] == "recovery"]
+    assert rec
+    starts = [e for t in rec for e in t["events"]
+              if e["name"] == "round_start"]
+    assert starts and all("objects" in e for e in starts)
+
+
+def test_trace_schema_red():
+    base = TraceCollector(seed=1).to_dict()
+    assert validate_trace_dump(base) == []          # empty but valid
+    bad = dict(base, trace_schema_version=99)
+    assert any("trace_schema_version" in e
+               for e in validate_trace_dump(bad))
+    bad = dict(base, traces=[{"kind": "client", "events": []}])
+    assert any("trace_id" in e for e in validate_trace_dump(bad))
+    bad = dict(base, traces=[{
+        "trace_id": "x", "kind": "client", "num": 0, "op": "encode",
+        "events": [{"name": "a", "t_ns": 5},
+                   {"name": "b", "t_ns": 3}]}])
+    assert any("time-ordered" in e for e in validate_trace_dump(bad))
+    bad = dict(base, background=[{"cls": "recovery", "t0_ns": 9,
+                                  "t1_ns": 3}])
+    assert any("ends before" in e for e in validate_trace_dump(bad))
+    bad = dict(base)
+    del bad["qos"]
+    assert any("qos" in e for e in validate_trace_dump(bad))
+
+
+# ----------------------------------------------------------------------
+# segment-sum == latency, across plugin families and ops
+
+TRACED_CODECS = [
+    CodecSpec("rs_k4_m2", "jerasure",
+              {"technique": "reed_sol_van", "k": "4", "m": "2"}, 4096),
+    CodecSpec("shec_k4_m3_c2", "shec",
+              {"k": "4", "m": "3", "c": "2"}, 4096),
+    CodecSpec("clay_k4_m2_d5", "clay",
+              {"k": "4", "m": "2", "d": "5"}, 4096),
+]
+
+
+@pytest.mark.parametrize("codec", TRACED_CODECS,
+                         ids=[c.name for c in TRACED_CODECS])
+def test_segment_sum_equals_latency(codec, no_collector):
+    """For EVERY completed request — encode, decode and repair — the
+    six segments sum exactly (integer ns) to the trace's end-to-end
+    time, which matches the SLO ledger's measured latency on the same
+    clock."""
+    clock = FakeClock()
+    coll = TraceCollector(clock=clock, seed=11)
+    prev = tracing.install(coll)
+    try:
+        spec = TrafficSpec(
+            seed=11, n_requests=36, codecs=[codec], arrival="closed",
+            erasures=1, concurrency=9, ladder=(1, 2, 4, 8),
+            op_mix={"encode": 0.4, "decode": 0.35, "repair": 0.25})
+        run = run_serving_scenario(
+            spec, clock=clock, executor="host",
+            service_model=throughput_service_model())
+    finally:
+        tracing.install(prev)
+    rows = analyzer.decompose_all(coll.to_dict())
+    assert len(rows) == len(run.results) == 36
+    assert {r["op"] for r in rows} == {"encode", "decode", "repair"}
+    by_id = {r["trace_id"]: r for r in rows}
+    for res in run.results:
+        row = by_id[res.request.trace.trace_id]
+        assert set(row["segments"]) == set(SEGMENTS)
+        assert sum(row["segments"].values()) == row["end_to_end_ns"]
+        assert all(v >= 0 for v in row["segments"].values()), row
+        assert abs(row["end_to_end_ns"] / 1e9 - res.latency) < 1e-9
+        # the many-to-one request→batch link and the program the
+        # batch rode are both on the trace
+        assert row["batch_seq"] is not None
+        assert row["rung"] >= row["occupancy"] >= 1
+        assert row["program"] is not None
+
+
+# ----------------------------------------------------------------------
+# sampling gates
+
+def test_tracing_off_records_nothing(no_collector):
+    """No collector ⇒ requests carry no trace, the SLO report carries
+    no exemplars, and nothing anywhere accumulates."""
+    assert not tracing.enabled()
+    spec = TrafficSpec(
+        seed=3, n_requests=12, codecs=[TRACED_CODECS[0]],
+        ladder=(1, 2, 4), concurrency=4)
+    run = run_serving_scenario(
+        spec, clock=FakeClock(), executor="host",
+        service_model=throughput_service_model())
+    assert all(r.request.trace is None for r in run.results)
+    assert all("p99_exemplars" not in v
+               for v in run.report["op_classes"].values())
+
+
+def test_sampling_zero_mints_no_client_traces(no_collector):
+    _, coll = traced_scenario(seed=5, n_requests=24, sample=0.0)
+    dump = coll.to_dict()
+    assert [t for t in dump["traces"] if t["kind"] == "client"] == []
+    assert analyzer.decompose_all(dump) == []
+    # background accounting still records (it is not per-request)
+    assert dump["background"]
+
+
+def test_sampling_is_deterministic():
+    a = TraceCollector(seed=9, sample=0.5)
+    b = TraceCollector(seed=9, sample=0.5)
+    picks_a = [a.sampled(i) for i in range(200)]
+    assert picks_a == [b.sampled(i) for i in range(200)]
+    assert 20 < sum(picks_a) < 180          # actually samples
+    assert picks_a != [TraceCollector(seed=10, sample=0.5).sampled(i)
+                       for i in range(200)]
+
+
+# ----------------------------------------------------------------------
+# THE acceptance claim: p99 attribution under contention, arbiter
+# on vs off
+
+def test_tail_attribution_arbiter_shrinks_hold(no_collector):
+    """The pinned contention scenario: the p99 tail-attribution table
+    names arbiter_hold (and the combined wait) shares that SHRINK
+    when the arbiter is enabled vs the --no-arbiter control — the
+    instrument agrees with the SLO scorecard about why the arbiter
+    helps."""
+    on_run, on_coll = traced_scenario(seed=42, n_requests=128,
+                                      enabled=True)
+    off_run, off_coll = traced_scenario(seed=42, n_requests=128,
+                                        enabled=False)
+    on = analyzer.tail_shares(
+        analyzer.decompose_all(on_coll.to_dict()), "p99")
+    off = analyzer.tail_shares(
+        analyzer.decompose_all(off_coll.to_dict()), "p99")
+    assert on["requests"] == off["requests"] == 128
+    # contention is real in the control, and attributed
+    assert off["shares"]["arbiter_hold"] > 0
+    # the arbiter strictly removes background-charge time from the
+    # client tail: hold share AND absolute ms shrink, and the
+    # combined wait-side time (batch_wait + arbiter_hold) shrinks
+    assert on["shares"]["arbiter_hold"] < off["shares"]["arbiter_hold"]
+    assert on["mean_ms"]["arbiter_hold"] < off["mean_ms"]["arbiter_hold"]
+    on_wait = on["mean_ms"]["batch_wait"] + on["mean_ms"]["arbiter_hold"]
+    off_wait = (off["mean_ms"]["batch_wait"]
+                + off["mean_ms"]["arbiter_hold"])
+    assert on_wait < off_wait
+    # ... consistent with the scorecard the scenario suite pins
+    assert on_run.report.p99_ms < off_run.report.p99_ms
+    # and the off run shows qos decisions un-denied (arbiter off)
+    assert all(d["granted"] for d in off_coll.to_dict()["qos"])
+
+
+# ----------------------------------------------------------------------
+# the profiler join (device executor)
+
+def test_program_link_joins_attribution_rows(no_collector):
+    """A device-executor stream's traces name the EXACT profiler
+    series their batches rode, so attribution_rows() joins
+    per-trace."""
+    from ceph_tpu.telemetry import ProgramProfiler, set_global_profiler
+
+    prof = ProgramProfiler()
+    prev_prof = set_global_profiler(prof)
+    clock = FakeClock()
+    coll = TraceCollector(clock=clock, seed=17)
+    prev = tracing.install(coll)
+    try:
+        spec = TrafficSpec(
+            seed=17, n_requests=8,
+            codecs=[CodecSpec("rs_k2_m1", "jerasure",
+                              {"technique": "reed_sol_van",
+                               "k": "2", "m": "1"}, 512)],
+            ladder=(1, 2, 4), concurrency=4)
+        run = run_serving_scenario(spec, clock=clock,
+                                   executor="device",
+                                   service_model=None)
+    finally:
+        tracing.install(prev)
+        set_global_profiler(prev_prof)
+    rows = analyzer.decompose_all(coll.to_dict())
+    assert len(rows) == len(run.results) == 8
+    profiled = {r["series"] for r in prof.attribution_rows()}
+    for row in rows:
+        assert row["program"] in profiled, (row["program"], profiled)
+
+
+# ----------------------------------------------------------------------
+# exemplars (satellite)
+
+def test_histogram_exemplars_bounded_and_deterministic():
+    from ceph_tpu.telemetry import LatencyHistogram
+
+    h = LatencyHistogram(exemplars=2)
+    for i, v in enumerate((0.5, 0.1, 0.9, 0.9, 0.2)):
+        h.record(v, exemplar=f"t{i}")
+    ex = h.exemplars()
+    # top-2 by (value, recency): the NEWER 0.9 wins the tie
+    assert [(e["value"], e["trace_id"]) for e in ex] == \
+        [(0.9, "t3"), (0.9, "t2")]
+    assert "exemplars" in h.to_dict()
+    # capacity 0 (the default with tracing off) retains nothing and
+    # keeps the dump shape byte-compatible
+    h0 = LatencyHistogram()
+    h0.record(1.0, exemplar="tx")
+    assert h0.exemplars() == []
+    assert "exemplars" not in h0.to_dict()
+    # merge folds exemplar sets
+    h2 = LatencyHistogram(exemplars=2)
+    h2.record(0.95, exemplar="other")
+    h2.merge(h)
+    assert h2.exemplars()[0]["trace_id"] == "other"
+    assert len(h2.exemplars()) == 2
+
+
+def test_slo_report_links_p99_exemplars_to_traces(no_collector):
+    """With tracing on, the SLO report's op classes carry p99+
+    exemplars whose trace ids resolve to real collected traces."""
+    clock = FakeClock()
+    coll = TraceCollector(clock=clock, seed=23)
+    prev = tracing.install(coll)
+    try:
+        spec = TrafficSpec(
+            seed=23, n_requests=24, codecs=[TRACED_CODECS[0]],
+            ladder=(1, 2, 4), concurrency=6)
+        run = run_serving_scenario(
+            spec, clock=clock, executor="host",
+            service_model=throughput_service_model())
+    finally:
+        tracing.install(prev)
+    ids = {t.trace_id for t in coll.traces}
+    carried = [e for v in run.report["op_classes"].values()
+               for e in v.get("p99_exemplars", ())]
+    assert carried, "no exemplars in the traced SLO report"
+    assert all(e["trace_id"] in ids for e in carried)
+    assert all(e["latency_ms"] > 0 for e in carried)
+
+
+# ----------------------------------------------------------------------
+# spans bounded-deque eviction visibility (satellite bug fix)
+
+def test_spans_dropped_counter_and_once_event():
+    from ceph_tpu import telemetry
+    from ceph_tpu.telemetry import spans as spans_mod
+    from ceph_tpu.telemetry.spans import SpanTracer
+
+    reg = telemetry.MetricsRegistry()
+    prev = telemetry.set_global_metrics(reg)
+    sent_before = spans_mod._drop_event_sent
+    spans_mod._drop_event_sent = False
+    try:
+        tracer = SpanTracer(max_roots=2, annotate=False)
+        for i in range(5):
+            with tracer.span(f"root{i}"):
+                pass
+        assert tracer.dropped == 3
+        assert tracer.to_dict()["dropped"] == 3
+        assert reg.counter_value("telemetry_spans_dropped") == 3
+        events = [e for e in reg.dump()[reg.name].get("__events__", ())
+                  if e["event"] == "telemetry_spans_dropped"]
+        assert len(events) == 1                  # once per process
+        assert events[0]["max_roots"] == 2
+    finally:
+        spans_mod._drop_event_sent = sent_before
+        telemetry.set_global_metrics(prev)
+
+
+# ----------------------------------------------------------------------
+# bounding + audit + bench blob
+
+def test_collector_bounded_drops_counted():
+    clock = FakeClock()
+    coll = TraceCollector(clock=clock, seed=1, max_traces=3)
+    made = [coll.begin("client", i, "encode") for i in range(5)]
+    assert sum(1 for t in made if t is not None) == 3
+    assert coll.dropped == 2
+    assert coll.to_dict()["dropped"] == 2
+
+
+def test_tracing_entry_registered_and_green():
+    """telemetry.tracing is a host-tier audited entry: zero compiles,
+    zero device arrays, forever."""
+    from ceph_tpu.analysis.entrypoints import registry
+    from ceph_tpu.analysis.jaxpr_audit import (audit_entry_point,
+                                               run_sentinel)
+
+    ents = {e.name: e for e in registry()}
+    e = ents["telemetry.tracing"]
+    assert e.kind == "host"
+    built = e.build()
+    audit = audit_entry_point(e, built)
+    assert audit.findings == [], audit.findings
+    s = run_sentinel(e, built)
+    assert s.findings == [], s.findings
+    assert s.warm_compiles == 0
+
+
+def test_bench_serving_carries_tail_attribution():
+    """--workload serving reports the metric_version 12 blob: p99
+    segment shares that sum to ~1 plus the dominant segment."""
+    from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+
+    b = ErasureCodeBench()
+    b.setup(["--workload", "serving", "--device", "host",
+             "--size", "8192", "--requests", "32", "--seed", "42"])
+    res = b.run()
+    tail = res["tail_attribution"]
+    assert set(tail["shares"]) == set(SEGMENTS)
+    assert tail["requests"] == 32
+    assert tail["dominant"] in SEGMENTS
+    assert abs(sum(tail["shares"].values()) - 1.0) < 1e-3
+    json.dumps(res)
+
+
+# ----------------------------------------------------------------------
+# CLI gates (subprocess — the same invocations test_full.sh runs)
+
+def _run_cli(args):
+    return subprocess.run(
+        [sys.executable] + args, cwd=REPO, capture_output=True,
+        text=True, timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_perf_dump_traced_day_schema_and_determinism():
+    args = ["tools/perf_dump.py", "--scenario", "traced-day",
+            "--fake-clock", "--traces", "--validate",
+            "--requests", "24"]
+    a = _run_cli(args)
+    assert a.returncode == 0, a.stderr
+    b = _run_cli(args)
+    assert b.returncode == 0, b.stderr
+    da, db = json.loads(a.stdout), json.loads(b.stdout)
+    assert da["traces"] == db["traces"]
+    assert da["traces"]["traces"], "traced-day produced no traces"
+
+
+def test_trace_view_check_and_chrome(tmp_path):
+    out = tmp_path / "day.trace.json"
+    r = _run_cli(["tools/trace_view.py", "--run-scenario",
+                  "--requests", "24", "--check"])
+    assert r.returncode == 0, r.stderr
+    r2 = _run_cli(["tools/trace_view.py", "--run-scenario",
+                   "--requests", "24", "--chrome", str(out)])
+    assert r2.returncode == 0, r2.stderr
+    chrome = json.loads(out.read_text())
+    evs = chrome["traceEvents"]
+    assert any(e.get("ph") == "X" and e["name"].startswith("encode")
+               for e in evs)
+    assert any(e.get("ph") == "X" and e["name"] == "recovery"
+               for e in evs)
+    # summary mode renders the attribution table from the same dump
+    r3 = _run_cli(["tools/trace_view.py", "--run-scenario",
+                   "--requests", "24"])
+    assert r3.returncode == 0, r3.stderr
+    assert "arbiter_hold" in r3.stdout and "dominant:" in r3.stdout

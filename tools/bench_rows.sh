@@ -14,6 +14,14 @@
 # latency percentiles, docs/OBSERVABILITY.md); consumers that only
 # read `gbps` are unaffected — rows are appended verbatim.
 #
+# Since metric_version 12 (ISSUE 15) the serving and scenario rows
+# carry `tail_attribution` — the per-segment share of p99 time
+# (queue_wait / batch_wait / arbiter_hold / retry_backoff /
+# device_dispatch / demux) plus the dominant segment, computed from
+# the causal tracing plane (telemetry/tracing.py + analyzer.py,
+# docs/OBSERVABILITY.md "Causal tracing & tail attribution"), so a
+# tail-latency number that moves names which seam moved it.
+#
 # Since metric_version 9 (ISSUE 12) the decode rows also carry
 # `engine` (which tier select_matrix_engine routed the pattern's
 # composite matrix to: xor|mxu|pallas|xla) and `xor_schedule` (the

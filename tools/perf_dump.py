@@ -16,6 +16,20 @@ The telemetry gate in tools/test_full.sh runs this three ways:
                                                        # on the host
                                                        # bench row
 
+Causal-tracing extensions (ISSUE 15, docs/OBSERVABILITY.md "Causal
+tracing & tail attribution"):
+
+    perf_dump.py --scenario traced-day --fake-clock --traces --validate
+        run the canonical seeded production day with a trace collector
+        installed and include the `traces` section (the collector
+        dump, trace_schema_version 1) — byte-identical across reruns
+        under --fake-clock; tools/trace_view.py renders the summary
+        and the Perfetto timeline from the same dump.
+    perf_dump.py --check-overhead 3 --with-traces
+        the existing overhead gate with the trace collector ACTIVE
+        during the enabled series — tracing-enabled runs must hold the
+        same <=3% bound.
+
 Device-plane profiler extensions (ISSUE 10, schema_version 2):
 
     perf_dump.py --profile --validate
@@ -183,6 +197,29 @@ def run_unrecoverable_scenario(seed: int, objects: int,
     return dumps
 
 
+def run_traced_day(seed: int, requests: int, clock=None) -> None:
+    """The causal-tracing scenario (ISSUE 15): the canonical seeded
+    production day (scenario/spec.py::default_scenario) on the host
+    executor with the trace collector active — client traces, QoS
+    decisions, background charge intervals and recovery-round traces
+    all land in the collector main() installed.  With --fake-clock the
+    whole dump is byte-identical across runs."""
+    from ceph_tpu.scenario import default_scenario, run_scenario
+    from ceph_tpu.serve.loadgen import throughput_service_model
+
+    spec = default_scenario(seed=seed, n_requests=max(16, requests),
+                            damaged_objects=3, storm_events=4)
+    kw = {"executor": "host"}
+    if clock is not None:
+        kw["clock"] = clock
+        kw["service_model"] = throughput_service_model()
+    run = run_scenario(spec, **kw)
+    if not run.report.ok():
+        raise SystemExit("perf_dump: traced-day scenario failed its "
+                         "gates (bug, not a tracing problem): "
+                         f"{run.report.gates}")
+
+
 def run_profile_sweep(fake_clock: bool, repeats: int,
                       filters) -> int:
     """Sweep the jit-tier audit registry through the profiler
@@ -213,12 +250,19 @@ def run_profile_sweep(fake_clock: bool, repeats: int,
     return 0
 
 
-def check_overhead(threshold_pct: float, reps: int = 5) -> dict:
+def check_overhead(threshold_pct: float, reps: int = 5,
+                   traced: bool = False) -> dict:
     """Instrumentation overhead on the host-path bench row
     (rs_k8_m3_degraded_e1 shape): run the row ``reps`` times with
     telemetry recording ON and OFF, compare the min elapsed of each
-    (min-of-N is robust to scheduler noise where mean is not)."""
+    (min-of-N is robust to scheduler noise where mean is not).
+
+    ``traced`` (ISSUE 15): the enabled series additionally runs with
+    a trace collector installed — the same <=3% bound must hold for
+    tracing-enabled runs (every hot-path hook is one is-None check
+    plus per-trace bookkeeping only for sampled requests)."""
     from ceph_tpu.bench.erasure_code_benchmark import ErasureCodeBench
+    from ceph_tpu.telemetry import tracing
 
     argv = ["--plugin", "jerasure",
             "--parameter", "technique=reed_sol_van",
@@ -237,13 +281,20 @@ def check_overhead(threshold_pct: float, reps: int = 5) -> dict:
     for _ in range(reps):
         for on in (True, False):
             telemetry.set_enabled(on)
-            t0 = time.perf_counter()
-            one_run()
-            times[on].append(time.perf_counter() - t0)
+            prev = (tracing.install(tracing.TraceCollector(seed=7))
+                    if on and traced else None)
+            try:
+                t0 = time.perf_counter()
+                one_run()
+                times[on].append(time.perf_counter() - t0)
+            finally:
+                if on and traced:
+                    tracing.install(prev)
     telemetry.set_enabled(True)
     t_on, t_off = min(times[True]), min(times[False])
     overhead = max(0.0, (t_on - t_off) / t_off * 100.0)
     return {"enabled_s": t_on, "disabled_s": t_off,
+            "traced": traced,
             "overhead_pct": round(overhead, 3),
             "threshold_pct": threshold_pct,
             "ok": overhead <= threshold_pct}
@@ -253,14 +304,18 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="repair",
                     choices=["repair", "recovery-churn", "both",
-                             "unrecoverable", "none"],
+                             "unrecoverable", "traced-day", "none"],
                     help="seeded workload to run before dumping "
                          "(unrecoverable: a past-budget repair whose "
                          "UnrecoverableError freezes a flight-"
-                         "recorder post-mortem; none: dump whatever "
-                         "the process already recorded)")
+                         "recorder post-mortem; traced-day: the "
+                         "composed production day under the causal-"
+                         "tracing collector, implies --traces; none: "
+                         "dump whatever the process already recorded)")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--objects", type=int, default=6)
+    ap.add_argument("--requests", type=int, default=48,
+                    help="traced-day: client requests in the stream")
     ap.add_argument("--format", default="json",
                     choices=["json", "prom", "both"])
     ap.add_argument("--indent", type=int, default=None)
@@ -275,6 +330,15 @@ def main(argv=None) -> int:
                     metavar="PCT",
                     help="measure instrumentation overhead on the "
                          "host-path bench row; rc 3 if above PCT")
+    ap.add_argument("--with-traces", action="store_true",
+                    help="run the --check-overhead enabled series "
+                         "with a trace collector installed (the "
+                         "tracing-enabled overhead gate)")
+    ap.add_argument("--traces", action="store_true",
+                    help="install a causal-tracing collector for the "
+                         "scenario and include its dump as the "
+                         "`traces` section (trace_schema_version 1; "
+                         "implied by --scenario traced-day)")
     ap.add_argument("--profile", action="store_true",
                     help="sweep every jit-tier audited entry point "
                          "through the cost-attribution profiler and "
@@ -296,10 +360,13 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.check_overhead is not None:
-        res = check_overhead(args.check_overhead)
+        res = check_overhead(args.check_overhead,
+                             traced=args.with_traces)
         print(json.dumps(res))
         return 0 if res["ok"] else 3
 
+    if args.scenario == "traced-day":
+        args.traces = True
     clock = None
     if args.fake_clock:
         from ceph_tpu.utils.retry import FakeClock
@@ -314,6 +381,11 @@ def main(argv=None) -> int:
         telemetry.install_compile_monitor()
     telemetry.install_flight_recorder()
     telemetry.reset_all()
+    prev_collector = None
+    if args.traces:
+        from ceph_tpu.telemetry import tracing
+        prev_collector = tracing.install(tracing.TraceCollector(
+            clock=clock, seed=args.seed))
     if args.scenario in ("repair", "both"):
         run_repair_scenario(args.seed, args.objects, clock=clock)
     if args.scenario in ("recovery-churn", "both"):
@@ -321,6 +393,8 @@ def main(argv=None) -> int:
     if args.scenario == "unrecoverable":
         run_unrecoverable_scenario(args.seed, args.objects,
                                    clock=clock)
+    if args.scenario == "traced-day":
+        run_traced_day(args.seed, args.requests, clock=clock)
     if args.profile:
         rc = run_profile_sweep(args.fake_clock, args.profile_repeats,
                                args.profile_filter)
@@ -328,7 +402,11 @@ def main(argv=None) -> int:
             return rc
 
     dump = telemetry.dump_all(profile=args.profile,
-                              flight=args.flight)
+                              flight=args.flight,
+                              traces=args.traces)
+    if args.traces:
+        from ceph_tpu.telemetry import tracing
+        tracing.install(prev_collector)
     if args.validate:
         errors = telemetry.validate_dump(dump)
         if errors:

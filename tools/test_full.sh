@@ -44,6 +44,20 @@ python tools/perf_dump.py --scenario both --fake-clock --validate \
     >/dev/null || { echo "perf_dump: telemetry schema gate failed"; exit 1; }
 python tools/perf_dump.py --check-overhead 3 \
     || { echo "perf_dump: instrumentation overhead above 3%"; exit 1; }
+# Causal-tracing gates (ISSUE 15 / docs/OBSERVABILITY.md "Causal
+# tracing & tail attribution"): (a) the seeded FakeClock production
+# day under the trace collector must emit a schema-valid unified dump
+# whose `traces` section validates (trace_schema_version 1);
+# (b) trace_view's gate mode pins exact segment sums AND byte-
+# identical replay across two runs of one seed; (c) the <=3% overhead
+# bound must hold with the collector ACTIVE (tracing-enabled runs).
+python tools/perf_dump.py --scenario traced-day --fake-clock --traces \
+    --validate >/dev/null \
+    || { echo "perf_dump: causal-tracing schema gate failed"; exit 1; }
+python tools/trace_view.py --run-scenario --check >/dev/null \
+    || { echo "trace_view: tracing determinism/decomposition gate failed"; exit 1; }
+python tools/perf_dump.py --check-overhead 3 --with-traces \
+    || { echo "perf_dump: tracing-enabled overhead above 3%"; exit 1; }
 # Device-plane profiler gates (ISSUE 10 / docs/OBSERVABILITY.md
 # "Device-plane profiler"): (a) EVERY jit-tier audited entry point
 # must produce a cost/roofline attribution row (rc 1 inside perf_dump
