@@ -146,7 +146,12 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # plus the dominant segment — on success AND the host-only error
 # lines, so a tail number that moves names which seam moved it
 # (docs/OBSERVABILITY.md "Causal tracing & tail attribution").
-METRIC_VERSION = 12
+# v13 (ISSUE 16, concurrency-discipline tier): the audit-meta blob
+# gains `lockcheck` — whether the instrumented-lock runtime validator
+# (CEPH_TPU_LOCKCHECK=1, utils/locks.py) was live for the run, since
+# checked locks add bookkeeping per acquire and such rows must never
+# be compared against production numbers.
+METRIC_VERSION = 13
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -643,12 +648,19 @@ def _audit_meta() -> dict:
     try:
         from ceph_tpu.analysis.entrypoints import registry
         from ceph_tpu.analysis.jaxpr_audit import AUDIT_RULE_IDS
+        from ceph_tpu.utils.locks import lockcheck_enabled
         return {
             "audited_entrypoints": len(registry()),
             "audit_rules": sorted(AUDIT_RULE_IDS),
+            # whether the instrumented-lock validator was live for
+            # this run (CEPH_TPU_LOCKCHECK=1): checked locks add a
+            # bookkeeping step per acquire, so a row measured under
+            # lockcheck is not comparable to a production row
+            "lockcheck": lockcheck_enabled(),
         }
     except Exception:  # noqa: BLE001 — metadata must never kill a bench
-        return {"audited_entrypoints": None, "audit_rules": []}
+        return {"audited_entrypoints": None, "audit_rules": [],
+                "lockcheck": False}
 
 
 def _error_line(msg: str, cpp_gbps: float, cpp_src: str,

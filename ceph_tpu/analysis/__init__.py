@@ -48,10 +48,17 @@ from .entrypoints import EntryPoint, registry, registry_gaps
 from .jaxpr_audit import (AUDIT_RULE_IDS, EntryAudit, TraceReport,
                           audit_entry_point, audit_registry,
                           run_sentinel, stale_trace_pragmas)
+# conc tier: static lock/shared-state race analysis + the declarative
+# lock-order registry its runtime half (utils/locks.py) validates
+# against.  Pure AST, jax-free, like the AST tier.
+from .concurrency import (CONC_RULE_IDS, CONC_RULES, lint_conc_paths,
+                          scan_paths, static_lock_graph)
 
 __all__ = [
     "ALL_RULES",
     "AUDIT_RULE_IDS",
+    "CONC_RULES",
+    "CONC_RULE_IDS",
     "EntryAudit",
     "EntryPoint",
     "FileReport",
@@ -62,6 +69,7 @@ __all__ = [
     "TraceReport",
     "audit_entry_point",
     "audit_registry",
+    "lint_conc_paths",
     "lint_file",
     "lint_paths",
     "registry",
@@ -71,5 +79,7 @@ __all__ = [
     "render_trace_human",
     "render_trace_json",
     "run_sentinel",
+    "scan_paths",
     "stale_trace_pragmas",
+    "static_lock_graph",
 ]

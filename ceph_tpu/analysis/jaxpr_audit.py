@@ -43,12 +43,12 @@ from __future__ import annotations
 import dataclasses
 import inspect
 import os
-import threading
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from .entrypoints import Built, EntryPoint, registry, registry_gaps
 from .rules import Finding
 from .suppress import PragmaInfo, collect_pragmas
+from ..utils.locks import make_lock
 
 AUDIT_RULE_IDS = (
     "audit-float-lane",
@@ -148,6 +148,19 @@ class TraceReport:
     @property
     def suppressed(self) -> List[Finding]:
         return [f for e in self.entries for f in e.suppressed]
+
+    @property
+    def gap_findings(self) -> List[Finding]:
+        """Registry gaps as first-class findings: a gap alone (zero
+        per-entry findings) must still fail the run and render in the
+        same grep-able ``path:line:col: [rule]`` shape as everything
+        else — pinned by tests/test_tpu_lint.py."""
+        return [
+            Finding("audit-registry-gap", "<registry>", 0, 0, 0,
+                    f"public device surface '{gap}' is not declared "
+                    f"in analysis/entrypoints.py")
+            for gap in self.gaps
+        ]
 
     @property
     def ok(self) -> bool:
@@ -336,7 +349,7 @@ class _CompileCounter:
     swapped in under a lock."""
 
     _registered = False
-    _lock = threading.Lock()
+    _lock = make_lock("analysis.jaxpr_audit._CompileCounter._lock")
     _active: Optional["_CompileCounter"] = None
 
     def __init__(self) -> None:

@@ -10,9 +10,15 @@ from typing import List
 from .rules import ALL_RULES
 from .scanner import LintReport
 
+# bumped to 2 when the conc tier landed: every JSON payload now
+# carries ``lint_schema_version`` + ``tier`` so CI consumers can tell
+# the three machine-readable reports (ast | trace | conc) apart
+LINT_SCHEMA_VERSION = 2
+
 
 def render_human(report: LintReport, show_suppressed: bool = False,
-                 show_stale: bool = False) -> str:
+                 show_stale: bool = False,
+                 label: str = "tpu-lint") -> str:
     lines: List[str] = []
     for fr in report.files:
         for f in fr.findings:
@@ -30,14 +36,16 @@ def render_human(report: LintReport, show_suppressed: bool = False,
     ns = len(report.suppressed)
     stale = f", {len(report.stale)} stale" if show_stale else ""
     lines.append(
-        f"tpu-lint: {n} finding{'s' if n != 1 else ''} "
+        f"{label}: {n} finding{'s' if n != 1 else ''} "
         f"({ns} suppressed{stale}) in {n_files} file"
         f"{'s' if n_files != 1 else ''}")
     return "\n".join(lines)
 
 
-def render_json(report: LintReport) -> str:
+def render_json(report: LintReport, tier: str = "ast") -> str:
     payload = {
+        "lint_schema_version": LINT_SCHEMA_VERSION,
+        "tier": tier,
         "files": len(report.files),
         "findings": [f.as_dict() for f in report.findings],
         "suppressed": [f.as_dict() for f in report.suppressed],
@@ -48,9 +56,14 @@ def render_json(report: LintReport) -> str:
 
 
 def render_rules() -> str:
+    from .concurrency import CONC_RULES
+
     lines = []
     for rule in ALL_RULES:
         lines.append(f"{rule.id} [{rule.category}]")
+        lines.append(f"    {rule.description}")
+    for rule in CONC_RULES:
+        lines.append(f"{rule.id} [{rule.category}] (--conc)")
         lines.append(f"    {rule.description}")
     return "\n".join(lines)
 
@@ -77,10 +90,8 @@ def render_trace_human(report, show_suppressed: bool = False,
                 reason = f" ({f.suppress_reason})" if f.suppress_reason \
                     else ""
                 lines.append(f"{f.render()} [suppressed{reason}]")
-    for gap in report.gaps:
-        lines.append(f"<registry>:0:0: [audit-registry-gap] public "
-                     f"device surface '{gap}' is not declared in "
-                     f"analysis/entrypoints.py")
+    for f in report.gap_findings:
+        lines.append(f.render())
     if show_stale:
         for f in report.stale:
             lines.append(f.render())
@@ -97,6 +108,8 @@ def render_trace_human(report, show_suppressed: bool = False,
 
 def render_trace_json(report, show_stale: bool = False) -> str:
     payload = {
+        "lint_schema_version": LINT_SCHEMA_VERSION,
+        "tier": "trace",
         "entries": [
             {
                 "name": e.name,
@@ -113,6 +126,7 @@ def render_trace_json(report, show_stale: bool = False) -> str:
             for e in report.entries
         ],
         "gaps": list(report.gaps),
+        "gap_findings": [f.as_dict() for f in report.gap_findings],
         "stale": [f.as_dict() for f in report.stale] if show_stale
         else [],
         "ok": report.ok,

@@ -140,7 +140,9 @@ def _stale_findings(rel_path: str, pragmas, config: LintConfig
                     ) -> List[Finding]:
     """``disable=`` pragmas whose AST-tier rules matched nothing this
     scan.  Trace-tier (``audit-*``) pragmas are the jaxpr auditor's to
-    judge (jaxpr_audit.stale_trace_pragmas); skipped here.  Only
+    judge (jaxpr_audit.stale_trace_pragmas), and concurrency-tier
+    (``conc-*``) pragmas the lock analyzer's
+    (concurrency.lint_conc_paths); both skipped here.  Only
     meaningful on full-rule runs: a ``--rule``-filtered scan never
     marks the other rules' pragmas stale."""
     if config.enabled_rules is not None:
@@ -148,7 +150,8 @@ def _stale_findings(rel_path: str, pragmas, config: LintConfig
     out: List[Finding] = []
     for s in pragmas.suppressions:
         for rule in sorted(s.stale_rules()):
-            if rule.startswith("audit-") or rule in config.disabled_rules:
+            if (rule.startswith("audit-") or rule.startswith("conc-")
+                    or rule in config.disabled_rules):
                 continue
             line = s.line or 1
             reason = f" -- {s.reason}" if s.reason else ""

@@ -35,7 +35,6 @@ byte-identically — the same contract every chaos artifact carries.
 
 from __future__ import annotations
 
-import threading
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -43,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils.errors import TransientBackendError
+from ..utils.locks import make_lock
 
 DISPATCH_FAULT_KINDS = ("transient", "oom", "backend_loss", "hang",
                         "corrupt")
@@ -136,7 +136,7 @@ class DispatchFaultPlan:
         self.calls: Dict[str, int] = {}
         self.fired: List[FiredFault] = []
         self.cleared = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos.dispatch.DispatchFaultPlan._lock")
 
     def arm(self, fault: DispatchFault) -> DispatchFault:
         with self._lock:
@@ -255,7 +255,7 @@ def _seam_token(seam: str) -> int:
 # the process-wide armed plan (what the supervisor consults)
 
 _active: Optional[DispatchFaultPlan] = None
-_lock = threading.Lock()
+_lock = make_lock("chaos.dispatch._lock")
 
 
 def active_plan() -> Optional[DispatchFaultPlan]:

@@ -45,13 +45,13 @@ stay supervision-free by construction (the audit entries pin it).
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Callable, Optional, Tuple
 
 from ..telemetry import metrics as tel
 from ..telemetry import tracing as trc
 from ..utils.log import dout
+from ..utils.locks import make_lock
 
 DEFAULT_MAX_PATTERNS = 512
 
@@ -70,7 +70,7 @@ class PatternCache:
         # builds above this raise (tests arm it to pin "bounded jit
         # recompile count"); None = log-once observability only
         self.recompile_budget = recompile_budget
-        self._lock = threading.Lock()
+        self._lock = make_lock("codes.engine.PatternCache._lock")
         self._entries: "OrderedDict[tuple, object]" = OrderedDict()
         self.hits = 0
         self.builds = 0
@@ -146,7 +146,7 @@ class PatternCache:
 
 
 _global: Optional[PatternCache] = None
-_global_lock = threading.Lock()
+_global_lock = make_lock("codes.engine._global_lock")
 
 
 def global_pattern_cache() -> PatternCache:

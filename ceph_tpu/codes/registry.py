@@ -17,10 +17,10 @@ Thread-safety: registry mutex like the reference (plugins_lock).
 from __future__ import annotations
 
 import importlib
-import threading
 from typing import Dict, Optional
 
 from .interface import ErasureCodeInterface, ErasureCodeProfile
+from ..utils.locks import make_lock, make_rlock
 
 # version-gate string (ErasureCodePlugin.h -> __erasure_code_version;
 # mismatched plugins are refused at load time)
@@ -39,10 +39,10 @@ class ErasureCodePluginRegistry:
     """Singleton plugin registry (ErasureCodePlugin.cc -> instance())."""
 
     _instance: Optional["ErasureCodePluginRegistry"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = make_lock("codes.registry.ErasureCodePluginRegistry._instance_lock")
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()  # held across load like plugins_lock
+        self._lock = make_rlock("codes.registry.ErasureCodePluginRegistry._lock")  # held across load like plugins_lock
         self._plugins: Dict[str, ErasureCodePlugin] = {}
         self.disable_dlclose = True  # parity flag; no-op in-process
 
@@ -73,29 +73,41 @@ class ErasureCodePluginRegistry:
         ``directory`` overrides the python package to search (the
         erasure_code_dir equivalent); default is ceph_tpu.codes.plugins.
         """
-        with self._lock:  # whole load under the lock (ErasureCodePlugin.cc)
+        with self._lock:
             plugin = self._plugins.get(name)
             if plugin is not None:
                 return plugin
-            pkg = directory or "ceph_tpu.codes.plugins"
-            try:
-                module = importlib.import_module(f"{pkg}.{name}")
-            except ImportError as e:
-                raise IOError(
-                    f"load dlopen({pkg}.{name}): {e}") from e
-            version = getattr(module, "__erasure_code_version__", None)
-            if version is None:
-                raise IOError(
-                    f"load dlsym({name}, __erasure_code_version__): not found")
-            if version != ERASURE_CODE_VERSION:
-                raise IOError(
-                    f"erasure_code_init({name}): plugin version {version!r} "
-                    f"!= expected {ERASURE_CODE_VERSION!r}")
-            init = getattr(module, "__erasure_code_init__", None)
-            if init is None:
-                raise IOError(
-                    f"load dlsym({name}, __erasure_code_init__): not found")
-            init(name, self)
+        # The import happens OUTSIDE the lock — unlike the reference,
+        # which holds plugins_lock across the whole dlopen
+        # (ErasureCodePlugin.cc).  A cold plugin import executes real
+        # module code (~0.5s: table builds, jax imports) and the
+        # runtime lock validator (CEPH_TPU_LOCKCHECK) flagged the
+        # hold-across-import as a blocking-under-lock event; Python's
+        # import machinery is itself thread-safe and idempotent, so
+        # concurrent loaders race harmlessly and re-check below.
+        pkg = directory or "ceph_tpu.codes.plugins"
+        try:
+            module = importlib.import_module(f"{pkg}.{name}")
+        except ImportError as e:
+            raise IOError(
+                f"load dlopen({pkg}.{name}): {e}") from e
+        version = getattr(module, "__erasure_code_version__", None)
+        if version is None:
+            raise IOError(
+                f"load dlsym({name}, __erasure_code_version__): not found")
+        if version != ERASURE_CODE_VERSION:
+            raise IOError(
+                f"erasure_code_init({name}): plugin version {version!r} "
+                f"!= expected {ERASURE_CODE_VERSION!r}")
+        init = getattr(module, "__erasure_code_init__", None)
+        if init is None:
+            raise IOError(
+                f"load dlsym({name}, __erasure_code_init__): not found")
+        with self._lock:
+            plugin = self._plugins.get(name)
+            if plugin is not None:
+                return plugin  # a racing loader registered first
+            init(name, self)  # add() re-enters _lock (RLock)
             plugin = self._plugins.get(name)
             if plugin is None:
                 raise IOError(

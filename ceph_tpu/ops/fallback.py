@@ -32,6 +32,7 @@ from contextlib import contextmanager
 from typing import List, Optional, Tuple
 
 from ..utils.log import dout
+from ..utils.locks import make_lock
 
 ENGINES = ("pallas", "xla", "numpy")
 
@@ -77,7 +78,7 @@ class FallbackPolicy:
                 f"engine {self.force!r} must be one of {ENGINES}")
         self.probe_error: Optional[BaseException] = None
         self._logged: set = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("ops.fallback.FallbackPolicy._lock")
         self._kind: Optional[str] = None
         # live-demotion stack (ops/supervisor.py): each demote()
         # pushes the force it replaced so promote() restores exactly
@@ -101,16 +102,25 @@ class FallbackPolicy:
         persistent backend failure, and :meth:`invalidate` again when
         its health probe re-promotes.
         """
-        if self._kind is not None:
-            return self._kind
+        with self._lock:
+            if self._kind is not None:
+                return self._kind
+        # the probe itself runs UNLOCKED: backend init can stall on a
+        # wedged tunnel, and invalidate()/demote() must stay callable
+        # while it does.  First writer wins; a concurrent invalidate()
+        # landing between probe and publish just costs one re-probe.
         import jax
+        err: Optional[BaseException] = None
         try:
             kind = jax.default_backend()
         except (RuntimeError, ImportError) as e:
-            self.probe_error = e
+            err = e
             kind = NO_BACKEND
-        self._kind = kind
-        return kind
+        with self._lock:
+            if self._kind is None:
+                self._kind = kind
+                self.probe_error = err
+            return self._kind
 
     def invalidate(self) -> None:
         """Drop the cached probe result (and its error): the next
@@ -220,7 +230,7 @@ class FallbackPolicy:
 
 
 _global: Optional[FallbackPolicy] = None
-_global_lock = threading.Lock()
+_global_lock = make_lock("ops.fallback._global_lock")
 
 
 def global_policy() -> FallbackPolicy:

@@ -66,7 +66,6 @@ docs/ROBUSTNESS.md "Supervised dispatch plane".
 from __future__ import annotations
 
 import os
-import threading
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
@@ -75,6 +74,7 @@ import numpy as np
 from ..utils.errors import RetryExhausted, TransientBackendError
 from ..utils.log import dout
 from ..utils.retry import RetryPolicy, SystemClock, retry_call
+from ..utils.locks import make_lock
 
 # message markers for classifying REAL backend errors (jaxlib's
 # XlaRuntimeError subclasses RuntimeError; PJRT surfaces gRPC-style
@@ -191,7 +191,7 @@ class DispatchSupervisor:
         self._policy_override = policy
         self._cache_clear_override = cache_clear
         self._plane_ctl = plane_ctl
-        self._lock = threading.Lock()
+        self._lock = make_lock("ops.supervisor.DispatchSupervisor._lock")
         # demotion state (what re-promotion must restore)
         self._floor: Optional[str] = None      # "numpy" once demoted
         self._tier_demotions = 0
@@ -230,17 +230,19 @@ class DispatchSupervisor:
 
     @property
     def demoted(self) -> bool:
-        return (self._tier_demotions > 0
-                or self._plane_width0 is not None)
+        with self._lock:
+            return (self._tier_demotions > 0
+                    or self._plane_width0 is not None)
 
     def stats(self) -> dict:
         with self._lock:
             out = dict(self.counters)
-        out["demoted"] = self.demoted
-        out["tier_floor"] = self._floor
-        out["tier_demotions"] = self._tier_demotions
-        out["plane_width0"] = self._plane_width0
-        out["clean_probes"] = self._clean_probes
+            out["demoted"] = (self._tier_demotions > 0
+                              or self._plane_width0 is not None)
+            out["tier_floor"] = self._floor
+            out["tier_demotions"] = self._tier_demotions
+            out["plane_width0"] = self._plane_width0
+            out["clean_probes"] = self._clean_probes
         return out
 
     def reset_pacing(self) -> None:
@@ -250,21 +252,22 @@ class DispatchSupervisor:
         seeded run's tick cadence (and therefore its report) is
         independent of whatever supervised work ran earlier in the
         process (byte-identical replay)."""
-        self._since_probe = 0
-        self._verify_seq = 0
-        self._clean_probes = 0
+        with self._lock:
+            self._since_probe = 0
+            self._verify_seq = 0
+            self._clean_probes = 0
 
     def reset(self) -> None:
         """Forget demotion state and zero counters (tests)."""
         with self._lock:
             for k in self.counters:
                 self.counters[k] = 0
-        self._floor = None
-        self._tier_demotions = 0
-        self._plane_width0 = None
-        self._clean_probes = 0
-        self._since_probe = 0
-        self._verify_seq = 0
+            self._floor = None
+            self._tier_demotions = 0
+            self._plane_width0 = None
+            self._clean_probes = 0
+            self._since_probe = 0
+            self._verify_seq = 0
 
     # -- THE choke point -------------------------------------------------
 
@@ -467,8 +470,9 @@ class DispatchSupervisor:
                              self.clock.monotonic(), seam=seam,
                              from_devices=p.n_devices)
         n = p.n_devices
-        if self._plane_width0 is None:
-            self._plane_width0 = n
+        with self._lock:
+            if self._plane_width0 is None:
+                self._plane_width0 = n
         nxt = n // 2
         self._count("quarantines")
         tel.counter("supervisor_quarantines", seam=seam)
@@ -501,9 +505,10 @@ class DispatchSupervisor:
                 return _HOST
             raise err
         to = pol.demote()
-        self._tier_demotions += 1
-        if to == "numpy":
-            self._floor = "numpy"
+        with self._lock:
+            self._tier_demotions += 1
+            if to == "numpy":
+                self._floor = "numpy"
         from ..telemetry import tracing
         if tracing.enabled():
             tracing.annotate("supervisor_demote",
@@ -546,8 +551,10 @@ class DispatchSupervisor:
             # only array outputs have CRC-comparable bytes; seams
             # that return host bookkeeping objects are not verifiable
             return out
-        self._verify_seq += 1
-        if self._verify_seq % self.verify_every:
+        with self._lock:
+            self._verify_seq += 1
+            seq = self._verify_seq
+        if seq % self.verify_every:
             return out
         from ..telemetry import metrics as tel
         from ..telemetry import recorder
@@ -590,9 +597,12 @@ class DispatchSupervisor:
     def _after_dispatch(self) -> None:
         if not self.demoted:
             return
-        self._since_probe += 1
-        if self._since_probe >= self.probe_every:
-            self._since_probe = 0
+        with self._lock:
+            self._since_probe += 1
+            fire = self._since_probe >= self.probe_every
+            if fire:
+                self._since_probe = 0
+        if fire:
             self.tick()
 
     def _probe_ok(self) -> bool:
@@ -618,14 +628,17 @@ class DispatchSupervisor:
         if not self.demoted:
             return False
         if self._probe_ok():
-            self._clean_probes += 1
+            with self._lock:
+                self._clean_probes += 1
+                promote = self._clean_probes >= self.promote_after
             self._count("probe_clean")
             tel.counter("supervisor_probe_clean")
-            if self._clean_probes >= self.promote_after:
+            if promote:
                 self._repromote()
                 return True
         else:
-            self._clean_probes = 0
+            with self._lock:
+                self._clean_probes = 0
             self._count("probe_failed")
             tel.counter("supervisor_probe_failed")
         return False
@@ -634,16 +647,21 @@ class DispatchSupervisor:
         from ..telemetry import metrics as tel
         from ..telemetry import recorder
         pol = self._policy()
+        # claim the demotion state atomically, then act on the local
+        # copy: pol.promote()/plane activate take their own locks and
+        # must not run under ours (lockmodel rank discipline)
+        with self._lock:
+            n_demotions = self._tier_demotions
+            self._tier_demotions = 0
+            width0, self._plane_width0 = self._plane_width0, None
+            self._floor = None
+            self._clean_probes = 0
         restored = None
-        while self._tier_demotions > 0:
+        for _ in range(n_demotions):
             restored = pol.promote()
-            self._tier_demotions -= 1
-        if self._plane_width0 is not None and self._plane_ctl:
+        if width0 is not None and self._plane_ctl:
             from ..parallel import plane as planemod
-            planemod.activate(self._plane_width0)
-        width0, self._plane_width0 = self._plane_width0, None
-        self._floor = None
-        self._clean_probes = 0
+            planemod.activate(width0)
         self._cache_clear()
         from ..telemetry import tracing
         if tracing.enabled():
@@ -670,7 +688,7 @@ class DispatchSupervisor:
 # the process supervisor
 
 _global: Optional[DispatchSupervisor] = None
-_global_lock = threading.Lock()
+_global_lock = make_lock("ops.supervisor._global_lock")
 
 
 def global_supervisor() -> DispatchSupervisor:

@@ -36,6 +36,7 @@ from contextlib import contextmanager
 from typing import Optional
 
 from ..utils.log import dout
+from ..utils.locks import make_lock
 
 DEFAULT_AXIS = "stripe"
 
@@ -66,7 +67,7 @@ class DataPlane:
                 f"shape={dict(self.mesh.shape)})")
 
 
-_lock = threading.Lock()
+_lock = make_lock("parallel.plane._lock")
 _active: Optional[DataPlane] = None
 _env_resolved = False
 _tls = threading.local()

@@ -27,7 +27,6 @@ analysis/entrypoints.py.
 from __future__ import annotations
 
 import itertools
-import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
@@ -36,6 +35,7 @@ import numpy as np
 
 from ..telemetry import metrics as tel
 from ..telemetry import tracing
+from ..utils.locks import make_lock
 
 OPS = ("encode", "decode", "repair")
 
@@ -130,27 +130,36 @@ class AdmissionQueue:
         self.clock = clock if clock is not None else SystemClock()
         self.capacity = capacity
         self.slo = slo if slo is not None else SloPolicy()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.queue.AdmissionQueue._lock")
         self._pending: Deque[EcRequest] = deque()
         self.admitted = 0
         self.rejected = 0
 
     def submit(self, req: EcRequest) -> bool:
         now = self.clock.monotonic()
+        # telemetry is emitted AFTER the lock drops: counter/event
+        # take the registry and recorder locks, and the admission lock
+        # is the hottest in the serve path — holding it across another
+        # lock's critical section stretches every competing submit()
         with self._lock:
-            if len(self._pending) >= self.capacity:
+            depth = len(self._pending)
+            admitted_now = depth < self.capacity
+            if admitted_now:
+                req.arrival = now
+                if req.deadline is None:
+                    req.deadline = now + self.slo.deadline_for(req.op)
+                self._pending.append(req)
+                self.admitted += 1
+                depth += 1
+            else:
                 self.rejected += 1
-                tel.counter("serve_rejected", op=req.op)
-                tel.event("serve_admission_reject", op=req.op,
-                          req_id=req.req_id, depth=len(self._pending))
-                return False
-            req.arrival = now
-            if req.deadline is None:
-                req.deadline = now + self.slo.deadline_for(req.op)
-            self._pending.append(req)
-            self.admitted += 1
-            tel.counter("serve_admitted", op=req.op)
-            tel.gauge("serve_queue_depth", len(self._pending))
+        if not admitted_now:
+            tel.counter("serve_rejected", op=req.op)
+            tel.event("serve_admission_reject", op=req.op,
+                      req_id=req.req_id, depth=depth)
+            return False
+        tel.counter("serve_admitted", op=req.op)
+        tel.gauge("serve_queue_depth", depth)
         # causal trace minted AT admission (outside the queue lock —
         # minting is collector bookkeeping): the trace's first event
         # is the same `arrival` stamp the SLO ledger measures from
@@ -165,9 +174,11 @@ class AdmissionQueue:
         with self._lock:
             out = list(self._pending)
             self._pending.clear()
-            if out:
-                tel.gauge("serve_queue_depth", 0)
-            return out
+        if out:
+            # gauge emitted outside the lock (registry lock nests
+            # under it otherwise; same discipline as submit)
+            tel.gauge("serve_queue_depth", 0)
+        return out
 
     def __len__(self) -> int:
         with self._lock:

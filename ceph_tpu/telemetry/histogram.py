@@ -28,8 +28,9 @@ safe inside the tpu-audit host tier (telemetry must compile nothing).
 from __future__ import annotations
 
 import math
-import threading
 from typing import Dict, List, Optional, Tuple
+
+from ..utils.locks import make_lock
 
 # linear sub-buckets per power-of-two octave: relative resolution 1/64
 SUB = 64
@@ -83,7 +84,7 @@ class LatencyHistogram:
     (seconds by convention; the unit is the caller's contract)."""
 
     def __init__(self, exemplars: Optional[int] = None) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.histogram.LatencyHistogram._lock")
         self._buckets: Dict[int, int] = {}
         self._zeros = 0
         self.count = 0

@@ -32,12 +32,12 @@ or returns one device array).
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from collections import deque
 from typing import Dict, Optional, Tuple
 
 from .histogram import LatencyHistogram
+from ..utils.locks import make_lock
 
 LabelKey = Tuple[Tuple[str, str], ...]
 SeriesKey = Tuple[str, LabelKey]
@@ -71,7 +71,7 @@ class MetricsRegistry:
                  clock=None) -> None:
         self.name = name
         self.clock = clock if clock is not None else _SystemClock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.metrics.MetricsRegistry._lock")
         self._counters: Dict[SeriesKey, int] = {}
         self._gauges: Dict[SeriesKey, float] = {}
         self._hists: Dict[SeriesKey, LatencyHistogram] = {}
@@ -145,7 +145,8 @@ class MetricsRegistry:
     # -- readout ---------------------------------------------------------
 
     def counter_value(self, name: str, **labels) -> int:
-        return self._counters.get((name, _labels_key(labels)), 0)
+        with self._lock:
+            return self._counters.get((name, _labels_key(labels)), 0)
 
     def dump(self) -> dict:
         """The `perf dump` JSON shape: ``{registry: {series: value}}``
@@ -243,7 +244,7 @@ class MetricsRegistry:
 
 
 _global: Optional[MetricsRegistry] = None
-_global_lock = threading.Lock()
+_global_lock = make_lock("telemetry.metrics._global_lock")
 _enabled = True
 
 
@@ -335,7 +336,7 @@ def record_dispatch(name: str, eager: bool = True, **labels):
 # -- jax.monitoring bridge (compile events into the registry) -----------
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-_monitor_lock = threading.Lock()
+_monitor_lock = make_lock("telemetry.metrics._monitor_lock")
 _monitor_installed = False
 
 

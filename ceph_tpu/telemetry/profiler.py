@@ -45,12 +45,12 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from .histogram import LatencyHistogram
 from .metrics import series_name
+from ..utils.locks import make_lock
 
 # Nominal peak memory bandwidth per jax platform, GB/s — the roofline
 # denominator.  tpu: v5e HBM (the deployment target, tools/roofline.py
@@ -185,7 +185,7 @@ class ProgramProfiler:
 
     def __init__(self, clock=None) -> None:
         self.clock = clock if clock is not None else _SystemClock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.profiler.ProgramProfiler._lock")
         self._records: Dict[tuple, ProgramRecord] = {}
         self.captures = 0
         self.capture_errors = 0
@@ -376,7 +376,7 @@ class ProgramProfiler:
 
 
 _global: Optional[ProgramProfiler] = None
-_global_lock = threading.Lock()
+_global_lock = make_lock("telemetry.profiler._global_lock")
 
 
 def global_profiler() -> ProgramProfiler:

@@ -44,12 +44,12 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
 
 from ..utils.log import dout
+from ..utils.locks import make_lock
 
 FLIGHT_SCHEMA_VERSION = 1
 MAX_ENTRIES = 256
@@ -78,7 +78,7 @@ class FlightRecorder:
     def __init__(self, clock=None, max_entries: int = MAX_ENTRIES,
                  max_dumps: int = MAX_DUMPS) -> None:
         self.clock = clock if clock is not None else _SystemClock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.recorder.FlightRecorder._lock")
         self._entries: "deque[dict]" = deque(maxlen=max_entries)
         self._seq = 0
         self.dropped = 0
@@ -205,7 +205,7 @@ class FlightRecorder:
 
 
 _global: Optional[FlightRecorder] = None
-_global_lock = threading.Lock()
+_global_lock = make_lock("telemetry.recorder._global_lock")
 
 
 def global_flight_recorder() -> FlightRecorder:

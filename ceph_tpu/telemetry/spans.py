@@ -39,6 +39,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from ..utils.log import dout
+from ..utils.locks import make_lock
 
 SPAN_DEBUG_LEVEL = 20   # dout level for span enter/exit events
 
@@ -96,7 +97,7 @@ class SpanTracer:
                  annotate: Optional[bool] = None) -> None:
         self.clock = clock if clock is not None else _SystemClock()
         self.annotate = annotate
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.spans.SpanTracer._lock")
         self._tls = threading.local()
         self.finished: "deque[Span]" = deque(maxlen=max_roots)
         self.dropped = 0
@@ -206,7 +207,7 @@ class SpanTracer:
 
 
 _global: Optional[SpanTracer] = None
-_global_lock = threading.Lock()
+_global_lock = make_lock("telemetry.spans._global_lock")
 
 
 def global_tracer() -> SpanTracer:

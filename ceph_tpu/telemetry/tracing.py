@@ -59,6 +59,7 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from .metrics import series_name
+from ..utils.locks import make_lock
 
 TRACE_SCHEMA_VERSION = 1
 
@@ -147,7 +148,7 @@ class TraceCollector:
         self.seed = int(seed)
         self.sample = float(sample)
         self.max_traces = int(max_traces)
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.tracing.TraceCollector._lock")
         self.traces: List[TraceContext] = []
         self.dropped = 0
         self._aux_seq = 0
@@ -263,7 +264,7 @@ class TraceCollector:
 # this single check, so the disabled hot path is one load + compare)
 
 _active: Optional[TraceCollector] = None
-_lock = threading.Lock()
+_lock = make_lock("telemetry.tracing._lock")
 _tls = threading.local()
 
 

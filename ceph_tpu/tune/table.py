@@ -51,8 +51,9 @@ import functools
 import hashlib
 import json
 import os
-import threading
 from typing import Dict, List, Optional, Tuple
+
+from ..utils.locks import make_lock
 
 TABLE_SCHEMA_VERSION = 1
 ENV_KNOB = "CEPH_TPU_TUNE_TABLE"
@@ -114,7 +115,7 @@ def profile_str(plugin: str, profile: Dict[str, str]) -> str:
 # ----------------------------------------------------------------------
 # current-environment probe (what the staleness guard compares against)
 
-_env_lock = threading.Lock()
+_env_lock = make_lock("tune.table._env_lock")
 _env_cache: Optional[dict] = None
 
 
@@ -212,14 +213,20 @@ class BestConfigTable:
         self.entries: Dict[str, dict] = {}
         self._env = dict(env) if env is not None else None
         self._stale_warned: set = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("tune.table.BestConfigTable._lock")
 
     def env(self) -> dict:
         """The environment NEW entries are stamped with (the declared
         sweep environment, or the current process env)."""
+        # current_env() is probed OUTSIDE the lock (it may touch jax
+        # device enumeration); first memoized writer wins
         if self._env is None:
-            self._env = current_env()
-        return dict(self._env)
+            probed = current_env()
+            with self._lock:
+                if self._env is None:
+                    self._env = probed
+        with self._lock:
+            return dict(self._env)
 
     # -- write ----------------------------------------------------------
 
@@ -290,7 +297,9 @@ class BestConfigTable:
             raise ValueError("invalid best-config table: "
                              + "; ".join(errors[:5]))
         t = cls()
-        t.entries = {str(k): dict(v) for k, v in d["entries"].items()}
+        with t._lock:
+            t.entries = {str(k): dict(v)
+                         for k, v in d["entries"].items()}
         return t
 
     def save(self, path: str) -> None:
@@ -322,7 +331,7 @@ class BestConfigTable:
 # ----------------------------------------------------------------------
 # the process-wide installed table (what the seams consult)
 
-_lock = threading.Lock()
+_lock = make_lock("tune.table._lock")
 _active: Optional[BestConfigTable] = None
 _env_resolved = False
 _generation = 0

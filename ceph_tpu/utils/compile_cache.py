@@ -31,15 +31,19 @@ Wiring notes, pinned by tests/test_serve.py's two-process sentinel:
 from __future__ import annotations
 
 import os
-import threading
 from typing import Optional
 
-from ..telemetry import metrics as tel
 from .log import dout
+from .locks import make_lock
+
+# NOTE: telemetry is imported lazily inside the functions below — the
+# telemetry modules create their registry locks through utils.locks,
+# so a module-scope import here would close an import cycle
+# (telemetry.* → utils → compile_cache → telemetry).
 
 ENV_KNOB = "CEPH_TPU_COMPILE_CACHE"
 
-_lock = threading.Lock()
+_lock = make_lock("utils.compile_cache._lock")
 _initialized_dir: Optional[str] = None
 _monitor_installed = False
 
@@ -60,29 +64,45 @@ def maybe_initialize_compile_cache(
     d = cache_dir or compile_cache_dir()
     if not d:
         return None
+
+    def _check_same(existing: str) -> str:
+        if os.path.abspath(existing) != os.path.abspath(d):
+            raise ValueError(
+                f"compilation cache already initialized at "
+                f"{existing!r}; cannot re-point at {d!r}")
+        return existing
+
     with _lock:
         if _initialized_dir is not None:
-            if os.path.abspath(_initialized_dir) != os.path.abspath(d):
-                raise ValueError(
-                    f"compilation cache already initialized at "
-                    f"{_initialized_dir!r}; cannot re-point at {d!r}")
-            return _initialized_dir
-        try:
-            import jax
-        except ImportError:
-            return None
-        os.makedirs(d, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", d)
-        # zero the write thresholds: EC programs compile in well under
-        # the default 1 s floor and would never be cached
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
-                          -1)
-        _initialized_dir = d
-        tel.event("compile_cache_initialized", dir=d)
-        dout("serve", 5, f"persistent compilation cache at {d}")
-        return d
+            return _check_same(_initialized_dir)
+    try:
+        import jax
+    except ImportError:
+        return None
+    # the mkdir + jax config writes run OUTSIDE the memo lock (conc
+    # tier: no file I/O / device-config work under a lock).  Two
+    # first-callers racing on the SAME dir repeat idempotent work;
+    # racing on different dirs still raises below — one claims the
+    # memo, the other fails the _check_same, exactly as before.
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # zero the write thresholds: EC programs compile in well under
+    # the default 1 s floor and would never be cached
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      -1)
+    with _lock:
+        if _initialized_dir is None:
+            _initialized_dir = d
+        else:
+            return _check_same(_initialized_dir)
+    # telemetry after release: emitting takes the registry/recorder
+    # locks, which rank ABOVE this one in analysis/lockmodel.py
+    from ..telemetry import metrics as tel
+    tel.event("compile_cache_initialized", dir=d)
+    dout("serve", 5, f"persistent compilation cache at {d}")
+    return d
 
 
 def install_cache_monitor() -> bool:
@@ -100,6 +120,7 @@ def install_cache_monitor() -> bool:
             return False
 
         def _listener(name: str, **kw) -> None:
+            from ..telemetry import metrics as tel
             if name == "/jax/compilation_cache/cache_hits":
                 tel.counter("jax_persistent_cache_hits")
             elif name == "/jax/compilation_cache/cache_misses":

@@ -16,9 +16,10 @@ Two reference surfaces (SURVEY.md §5 config/flag row):
 from __future__ import annotations
 
 import os
-import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+from .locks import make_lock
 
 
 @dataclass
@@ -73,7 +74,7 @@ class Config:
     def __init__(self, options: Optional[List[Option]] = None) -> None:
         self._schema = {o.name: o for o in (options or OPTIONS)}
         self._values: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("utils.config.Config._lock")
 
     def get(self, name: str):
         opt = self._schema.get(name)

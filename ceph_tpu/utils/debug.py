@@ -25,6 +25,8 @@ import contextlib
 import os
 import threading
 
+from .locks import make_lock
+
 # nesting counters; ALL mutation happens under _ACTIVE_LOCK.  The nan
 # config is process-global jax state, so it is refcounted the same way:
 # the first enabler saves the original value, the last one restores it.
@@ -35,7 +37,7 @@ import threading
 _ACTIVE = 0
 _NAN_ACTIVE = 0
 _NAN_PREV = None
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = make_lock("utils.debug._ACTIVE_LOCK")
 
 
 class DeviceVerificationError(AssertionError):

@@ -14,9 +14,10 @@ from __future__ import annotations
 
 import os
 import sys
-import threading
 import time
 from typing import Dict, Optional, TextIO
+
+from .locks import make_lock
 
 SUBSYS = ("ec", "crush", "bench", "bridge", "registry",
           "telemetry")  # subsys.h role; telemetry: span enter/exit at
@@ -24,7 +25,7 @@ SUBSYS = ("ec", "crush", "bench", "bridge", "registry",
                         # live trace of the span tree as it opens)
 
 _levels: Dict[str, int] = {}
-_lock = threading.Lock()
+_lock = make_lock("utils.log._lock")
 _stream: TextIO = sys.stderr
 
 

@@ -16,9 +16,10 @@ device trace next to its counters.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Dict, Optional
+
+from .locks import make_lock
 
 
 class PerfCounters:
@@ -34,7 +35,7 @@ class PerfCounters:
 
     def __init__(self, name: str = "ceph_tpu") -> None:
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("utils.perf.PerfCounters._lock")
         self._u64: Dict[str, int] = {}
         self._time: Dict[str, list] = {}   # name -> [count, sum_seconds]
         self._gauge: Dict[str, float] = {}

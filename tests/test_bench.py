@@ -261,11 +261,16 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
+    # metric_version 13 (ISSUE 16): the audit-meta blob stamps
+    # whether the instrumented-lock runtime validator was live
+    # (CEPH_TPU_LOCKCHECK=1) — lockcheck rows never compare against
+    # production rows
+    assert bench.METRIC_VERSION == 13
+    assert "lockcheck" in bench._audit_meta()
     # metric_version 12 (ISSUE 15): the serving and scenario rows
     # carry the `tail_attribution` blob (per-segment share of p99
     # time from the causal tracing plane — tests/test_tracing.py
     # pins the blob shape on the workload result)
-    assert bench.METRIC_VERSION == 12
     assert "tail_attribution" in bench.SCENARIO_ROW_FIELDS
     # metric_version 11 (ISSUE 14): every workload row carries its
     # config provenance (config_source tuned|default + tune_key_hash)

@@ -1,0 +1,576 @@
+"""conc tier (ISSUE 16): static lock/shared-state race analysis.
+
+- red/green/suppressed behavior for each conc-* rule on synthetic
+  modules (the same trio discipline as the AST-tier lint_fixtures);
+- guard-set inference: writes AND reads under `with <lock>:` both
+  count as guard evidence; __init__ assignment never fires;
+- the interprocedural pieces: cross-module lock->lock edges through
+  the call graph, the private-helper held-at-every-call-site rule;
+- the lockmodel registry cross-check (unregistered lock, raw
+  threading creation, declared-id drift, stale registry entry);
+- the repo gate: ceph_tpu/ has zero unsuppressed conc findings and
+  the registry covers every lock-creating module;
+- CLI: --conc exit codes and the schema-v2 JSON shape.
+"""
+
+import json
+import subprocess
+import sys
+import pathlib
+
+import pytest
+
+from ceph_tpu.analysis import lockmodel
+from ceph_tpu.analysis.concurrency import (
+    CONC_RULE_IDS,
+    ConcModel,
+    lint_conc_paths,
+    module_name_for,
+    scan_paths,
+    static_lock_graph,
+)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _findings(src: str, ranks, specs=(), rel: str = "mod.py"):
+    model = ConcModel(registry_ranks=dict(ranks),
+                      registry_specs=list(specs))
+    err = model.add_source(src, rel)
+    assert err is None, err
+    model.analyze()
+    return [f for fs in model.findings.values() for f in fs]
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ----------------------------------------------------------------------
+# conc-unguarded-write
+
+GUARDED_CLASS = '''
+from ceph_tpu.utils.locks import make_lock
+
+class C:
+    def __init__(self):
+        self._lock = make_lock("mod.C._lock")
+        self.x = 0
+
+    def inc(self):
+        with self._lock:
+            self.x += 1
+
+    def bad(self):
+        self.x = 5
+'''
+
+
+def test_unguarded_write_red():
+    found = _findings(GUARDED_CLASS, {"mod.C._lock": 10})
+    assert _rules(found) == ["conc-unguarded-write"]
+    f = found[0]
+    assert f.line == 14
+    assert "'x'" in f.message and "mod.C._lock" in f.message
+    # the message names the guarded evidence site
+    assert "line 11" in f.message
+
+
+def test_unguarded_write_green_when_all_sites_guarded():
+    src = GUARDED_CLASS.replace(
+        "    def bad(self):\n        self.x = 5",
+        "    def also_ok(self):\n"
+        "        with self._lock:\n"
+        "            self.x = 5")
+    assert _findings(src, {"mod.C._lock": 10}) == []
+
+
+def test_init_assignment_is_not_mutation():
+    # __init__ writes are initialization — only the post-init
+    # unguarded write may fire, never the constructor's
+    found = _findings(GUARDED_CLASS, {"mod.C._lock": 10})
+    assert all(f.line != 7 for f in found)
+
+
+def test_reads_under_lock_count_as_guard_evidence():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+
+class C:
+    def __init__(self):
+        self._lock = make_lock("mod.C._lock")
+        self.items = []
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.items)
+
+    def bad(self):
+        self.items.append(1)
+'''
+    found = _findings(src, {"mod.C._lock": 10})
+    assert _rules(found) == ["conc-unguarded-write"]
+    assert "append" in found[0].message
+
+
+def test_container_mutator_under_lock_green():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+
+_lock = make_lock("mod._lock")
+_seen = set()
+
+def note(x):
+    with _lock:
+        _seen.add(x)
+'''
+    assert _findings(src, {"mod._lock": 10}) == []
+
+
+def test_private_helper_held_at_every_call_site():
+    # the LockMonitor._stat pattern: a private helper mutating
+    # guarded state is clean when EVERY resolved caller holds the
+    # lock at the call site
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+
+class C:
+    def __init__(self):
+        self._lock = make_lock("mod.C._lock")
+        self.stats = {}
+
+    def _bump(self, k):
+        self.stats[k] = self.stats.get(k, 0) + 1
+
+    def record(self, k):
+        with self._lock:
+            self._bump(k)
+
+    def record2(self, k):
+        with self._lock:
+            self._bump(k)
+'''
+    assert _findings(src, {"mod.C._lock": 10}) == []
+    # one unlocked caller kills the entry-held guarantee; with guard
+    # evidence elsewhere (clear's locked write) the helper's write is
+    # unguarded again
+    src_bad = src + '''
+    def clear(self):
+        with self._lock:
+            self.stats = {}
+
+    def sloppy(self, k):
+        self._bump(k)
+'''
+    found = _findings(src_bad, {"mod.C._lock": 10})
+    assert "conc-unguarded-write" in _rules(found)
+    bad = [f for f in found if f.rule == "conc-unguarded-write"]
+    assert any("'stats'" in f.message for f in bad)
+
+
+# ----------------------------------------------------------------------
+# conc-blocking-under-lock
+
+def test_blocking_under_lock_red():
+    src = '''
+import time
+from ceph_tpu.utils.locks import make_lock
+
+_lock = make_lock("mod._lock")
+
+def f():
+    with _lock:
+        time.sleep(1)
+'''
+    found = _findings(src, {"mod._lock": 10})
+    assert _rules(found) == ["conc-blocking-under-lock"]
+    assert "time.sleep" in found[0].message
+    assert "mod._lock" in found[0].message
+
+
+@pytest.mark.parametrize("call, label", [
+    ("out.block_until_ready()", "device sync"),
+    ("jax.device_put(x)", "device transfer"),
+    ("open('/tmp/f').read()", "file I/O"),
+    ("os.replace(a, b)", "file I/O"),
+    ("fut.result()", "future result"),
+    ("cv.wait()", "wait"),
+])
+def test_blocking_call_classes(call, label):
+    src = f'''
+import os
+import jax
+from ceph_tpu.utils.locks import make_lock
+
+_lock = make_lock("mod._lock")
+
+def f(a, b, x, out, fut, cv):
+    with _lock:
+        {call}
+'''
+    found = _findings(src, {"mod._lock": 10})
+    assert _rules(found) == ["conc-blocking-under-lock"]
+    assert label in found[0].message
+
+
+def test_blocking_outside_lock_green():
+    src = '''
+import time
+from ceph_tpu.utils.locks import make_lock
+
+_lock = make_lock("mod._lock")
+
+def f():
+    with _lock:
+        pass
+    time.sleep(1)
+'''
+    assert _findings(src, {"mod._lock": 10}) == []
+
+
+def test_blocking_through_callee_under_lock():
+    # the lock is held across a call into a function that blocks —
+    # the transitive case the runtime validator sees as held-duration
+    src = '''
+import time
+from ceph_tpu.utils.locks import make_lock
+
+_lock = make_lock("mod._lock")
+
+def _slow():
+    time.sleep(1)
+
+def f():
+    with _lock:
+        _slow()
+'''
+    found = _findings(src, {"mod._lock": 10})
+    assert "conc-blocking-under-lock" in _rules(found)
+    assert "held at every call site" in found[0].message
+
+
+# ----------------------------------------------------------------------
+# conc-lock-cycle
+
+def test_lock_cycle_red():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+
+_a = make_lock("mod._a")
+_b = make_lock("mod._b")
+
+def f():
+    with _a:
+        with _b:
+            pass
+
+def g():
+    with _b:
+        with _a:
+            pass
+'''
+    found = _findings(src, {"mod._a": 1, "mod._b": 2})
+    rules = _rules(found)
+    assert rules.count("conc-lock-cycle") == 3  # 2 cycle edges + 1 rank
+    msgs = " | ".join(f.message for f in found)
+    assert "cycle" in msgs
+    # the b->a edge also inverts the declared rank order
+    assert "inverts the declared lock order" in msgs
+
+
+def test_rank_inversion_without_cycle():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+
+_a = make_lock("mod._a")
+_b = make_lock("mod._b")
+
+def g():
+    with _b:
+        with _a:
+            pass
+'''
+    found = _findings(src, {"mod._a": 1, "mod._b": 2})
+    assert _rules(found) == ["conc-lock-cycle"]
+    assert "rank" in found[0].message
+
+
+def test_self_reacquire_non_reentrant():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+_a = make_lock("mod._a")
+
+def f():
+    with _a:
+        with _a:
+            pass
+'''
+    found = _findings(src, {"mod._a": 1})
+    assert _rules(found) == ["conc-lock-cycle"]
+    assert "self-deadlock" in found[0].message
+
+
+def test_rlock_self_reacquire_green():
+    src = '''
+from ceph_tpu.utils.locks import make_rlock
+_a = make_rlock("mod._a")
+
+def f():
+    with _a:
+        with _a:
+            pass
+'''
+    assert _findings(src, {"mod._a": 1}) == []
+
+
+def test_cross_module_edge_through_call_graph():
+    # serve.queue -> telemetry.metrics shape: the edge exists even
+    # though the two `with` statements live in different files
+    low = '''
+from ceph_tpu.utils.locks import make_lock
+from ceph_tpu.high import g
+
+_lock = make_lock("low._lock")
+
+def f():
+    with _lock:
+        g()
+'''
+    high = '''
+from ceph_tpu.utils.locks import make_lock
+
+_lock = make_lock("high._lock")
+
+def g():
+    with _lock:
+        pass
+'''
+    model = ConcModel(registry_ranks={"low._lock": 1, "high._lock": 2},
+                      registry_specs=[])
+    assert model.add_source(low, "ceph_tpu/low.py") is None
+    assert model.add_source(high, "ceph_tpu/high.py") is None
+    model.analyze()
+    edges = {(e.src, e.dst) for e in model.edges}
+    assert ("low._lock", "high._lock") in edges
+    assert [f for fs in model.findings.values() for f in fs] == []
+    # flip the declared ranks and the same edge is an inversion
+    model2 = ConcModel(registry_ranks={"low._lock": 2, "high._lock": 1},
+                       registry_specs=[])
+    model2.add_source(low, "ceph_tpu/low.py")
+    model2.add_source(high, "ceph_tpu/high.py")
+    model2.analyze()
+    found = [f for fs in model2.findings.values() for f in fs]
+    assert _rules(found) == ["conc-lock-cycle"]
+
+
+# ----------------------------------------------------------------------
+# conc-registry-gap
+
+def test_registry_gap_unregistered():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+_lock = make_lock("mod._lock")
+'''
+    found = _findings(src, {})
+    assert _rules(found) == ["conc-registry-gap"]
+    assert "not declared in" in found[0].message
+
+
+def test_registry_gap_raw_threading():
+    src = '''
+import threading
+_lock = threading.Lock()
+'''
+    found = _findings(src, {"mod._lock": 10})
+    assert _rules(found) == ["conc-registry-gap"]
+    assert "raw threading.Lock()" in found[0].message
+    assert "make_lock" in found[0].message
+
+
+def test_registry_gap_declared_id_drift():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+_lock = make_lock("other.name")
+'''
+    found = _findings(src, {"mod._lock": 10, "other.name": 11})
+    assert _rules(found) == ["conc-registry-gap"]
+    assert "does not match the creation site" in found[0].message
+
+
+def test_registry_gap_non_literal_id():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+NAME = "mod._lock"
+_lock = make_lock(NAME)
+'''
+    found = _findings(src, {"mod._lock": 10})
+    assert _rules(found) == ["conc-registry-gap"]
+    assert "string literal" in found[0].message
+
+
+def test_registry_gap_stale_entry():
+    src = '''
+from ceph_tpu.utils.locks import make_lock
+_lock = make_lock("mod._lock")
+'''
+    specs = [lockmodel.LockSpec("mod._lock", "mod", 10, "lock", "x"),
+             lockmodel.LockSpec("mod._gone", "mod", 11, "lock", "y")]
+    found = _findings(src, {"mod._lock": 10, "mod._gone": 11},
+                      specs=specs)
+    assert _rules(found) == ["conc-registry-gap"]
+    assert "stale lockmodel entry" in found[0].message
+    assert "mod._gone" in found[0].message
+
+
+# ----------------------------------------------------------------------
+# pragmas / lint_conc_paths plumbing
+
+def test_pragma_suppresses_and_stale_detection(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text('''
+import time
+from ceph_tpu.utils.locks import make_lock
+
+_lock = make_lock("mod._lock")
+
+def f():
+    with _lock:
+        time.sleep(1)  # tpu-lint: disable=conc-blocking-under-lock -- test fixture
+''')
+    rep = lint_conc_paths([str(mod)], registry_ranks={"mod._lock": 10},
+                          registry_specs=[])
+    assert rep.findings == [] and len(rep.suppressed) == 1
+    assert rep.suppressed[0].suppress_reason == "test fixture"
+
+    # remove the blocking call: the pragma is now stale, but ONLY
+    # under --check-suppressions
+    mod.write_text('''
+from ceph_tpu.utils.locks import make_lock
+
+_lock = make_lock("mod._lock")
+
+def f():
+    with _lock:
+        pass  # tpu-lint: disable=conc-blocking-under-lock -- test fixture
+''')
+    rep = lint_conc_paths([str(mod)], registry_ranks={"mod._lock": 10},
+                          registry_specs=[])
+    assert rep.findings == [] and rep.stale == []
+    rep = lint_conc_paths([str(mod)], registry_ranks={"mod._lock": 10},
+                          registry_specs=[], check_suppressions=True)
+    assert len(rep.stale) == 1
+    assert "conc-blocking-under-lock" in rep.stale[0].message
+
+
+def test_stale_check_ignores_other_tiers(tmp_path):
+    # an audit-* pragma in scanned source is the trace tier's to
+    # judge; the conc stale pass must not flag it
+    mod = tmp_path / "mod.py"
+    mod.write_text('''
+def f():
+    pass  # tpu-lint: disable=audit-float-lane -- trace tier's business
+''')
+    rep = lint_conc_paths([str(mod)], registry_ranks={},
+                          registry_specs=[], check_suppressions=True)
+    assert rep.stale == []
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    mod = tmp_path / "broken.py"
+    mod.write_text("def f(:\n")
+    rep = lint_conc_paths([str(mod)], registry_ranks={},
+                          registry_specs=[])
+    assert not rep.ok
+    assert rep.findings[0].rule == "parse-error"
+
+
+# ----------------------------------------------------------------------
+# the repo gate + registry coverage (tentpole acceptance)
+
+def test_repo_tree_has_zero_unsuppressed_conc_findings():
+    rep = lint_conc_paths([str(REPO_ROOT / "ceph_tpu")])
+    msgs = "\n".join(f.render() for f in rep.findings)
+    assert rep.ok, f"unsuppressed conc findings:\n{msgs}"
+
+
+def test_registry_covers_every_lock_creating_module():
+    model, _, errors = scan_paths([str(REPO_ROOT / "ceph_tpu")])
+    assert errors == {}
+    registered = set(lockmodel.lock_ids())
+    # every discovered factory-made lock is declared (the two
+    # monitor-internal locks in utils/locks.py are raw by design and
+    # carry their own pragma)
+    discovered = {d.id for d in model.locks.values() if d.via_factory}
+    missing = discovered - registered
+    assert not missing, f"locks missing from lockmodel: {sorted(missing)}"
+    # and every registry entry still corresponds to a real lock
+    stale = registered - {d.id for d in model.locks.values()}
+    assert not stale, f"stale lockmodel entries: {sorted(stale)}"
+
+
+def test_static_lock_graph_shape_and_rank_consistency():
+    graph = static_lock_graph([str(REPO_ROOT / "ceph_tpu")])
+    assert set(graph) == {"locks", "edges", "ranks"}
+    assert graph["locks"]  # the tree defines locks
+    # every edge between REGISTERED locks ascends the declared ranks
+    # (the zero-findings gate above already guarantees this; assert
+    # it directly so the exported graph is self-consistent)
+    ranks = graph["ranks"]
+    for src, dst in graph["edges"]:
+        if src in ranks and dst in ranks:
+            assert ranks[src] < ranks[dst], (src, dst)
+
+
+def test_lockmodel_registry_sanity():
+    ids = lockmodel.lock_ids()
+    assert len(ids) == len(set(ids))
+    for spec in lockmodel.LOCKS:
+        assert spec.id.startswith(spec.module)
+        assert spec.kind in ("lock", "rlock", "condition")
+        assert isinstance(spec.rank, int)
+    assert lockmodel.spec("serve.queue.AdmissionQueue._lock").rank \
+        < lockmodel.spec("telemetry.metrics.MetricsRegistry._lock").rank
+
+
+def test_module_name_for():
+    assert module_name_for("ceph_tpu/serve/queue.py") == "serve.queue"
+    assert module_name_for("ceph_tpu/__init__.py") == "__init__"
+    assert module_name_for("tools/tpu_lint.py") == "tpu_lint"
+
+
+# ----------------------------------------------------------------------
+# CLI
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "tpu_lint.py"),
+         *args],
+        capture_output=True, text=True, cwd=str(REPO_ROOT))
+
+
+def test_cli_conc_clean_tree_exit_zero():
+    res = _run_cli("--conc", "ceph_tpu/")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "tpu-conc: 0 findings" in res.stdout
+
+
+def test_cli_conc_red_file_exit_one_and_json_schema(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text('''
+import threading
+_lock = threading.Lock()
+''')
+    res = _run_cli("--conc", "--json", str(bad))
+    assert res.returncode == 1
+    doc = json.loads(res.stdout)
+    assert doc["lint_schema_version"] == 2
+    assert doc["tier"] == "conc"
+    assert doc["ok"] is False
+    assert doc["findings"][0]["rule"] == "conc-registry-gap"
+
+
+def test_cli_list_rules_includes_conc():
+    res = _run_cli("--list-rules")
+    assert res.returncode == 0
+    for rule in sorted(CONC_RULE_IDS):
+        assert rule in res.stdout

@@ -437,3 +437,37 @@ def test_shec_decode_byte_identity_after_take_static():
     ref = ec.decode_chunks_batch(stack[:, list(available)], available,
                                  erased)
     np.testing.assert_array_equal(got, np.asarray(ref))
+
+
+# ----------------------------------------------------------------------
+# registry gaps are first-class findings (ISSUE 16 satellite): a gap
+# with ZERO per-entry findings must still fail the run and render
+
+def test_registry_gap_alone_fails_and_renders():
+    report = TraceReport(entries=[], gaps=["clay.decode_chunks_jax"])
+    assert not report.ok                      # the non-zero-exit driver
+    assert report.findings == []              # no AST/trace findings...
+    [gf] = report.gap_findings                # ...the gap IS the finding
+    assert gf.rule == "audit-registry-gap"
+    assert "clay.decode_chunks_jax" in gf.message
+    assert "entrypoints.py" in gf.message
+    # grep-able path:line:col: [rule] shape like every other finding
+    assert "[audit-registry-gap]" in gf.render()
+
+
+def test_render_trace_carries_gap_findings():
+    import json as _json
+
+    from ceph_tpu.analysis.report import (render_trace_human,
+                                          render_trace_json)
+
+    report = TraceReport(entries=[], gaps=["ops.missing_surface"])
+    human = render_trace_human(report)
+    assert "audit-registry-gap" in human
+    assert "ops.missing_surface" in human
+    doc = _json.loads(render_trace_json(report))
+    assert doc["ok"] is False
+    assert doc["tier"] == "trace"
+    assert doc["lint_schema_version"] == 2
+    assert any(g["rule"] == "audit-registry-gap"
+               for g in doc["gap_findings"])

@@ -1,0 +1,353 @@
+"""Runtime lock-order validator (ISSUE 16, docs/LINT.md "Tier 4").
+
+Unit tests drive CheckedLock/CheckedRLock against an explicit
+LockMonitor on a fake clock; the acceptance test runs the seeded
+dispatch-chaos family in a subprocess under CEPH_TPU_LOCKCHECK=1 and
+cross-checks the runtime report against the static lock graph: every
+runtime edge must be predicted by the conc tier, with zero order
+violations and zero blocking-under-lock events.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ceph_tpu.utils import locks
+from ceph_tpu.utils.locks import (
+    DEFAULT_BLOCKING_THRESHOLD_S,
+    LOCKCHECK_ENV,
+    LOCKCHECK_SCHEMA_VERSION,
+    CheckedLock,
+    CheckedRLock,
+    LockMonitor,
+    global_monitor,
+    lockcheck_enabled,
+    lockcheck_report,
+    make_lock,
+    make_rlock,
+    reset_monitor,
+    validate_lockcheck_report,
+)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def mon():
+    return LockMonitor(clock=Clock(), ranks={"a": 1, "b": 2, "c": 3})
+
+
+# ----------------------------------------------------------------------
+# factory gating
+
+def test_make_lock_plain_when_disabled(monkeypatch):
+    monkeypatch.delenv(LOCKCHECK_ENV, raising=False)
+    assert not lockcheck_enabled()
+    lk = make_lock("utils.locks.test")
+    assert type(lk) is type(threading.Lock())
+    rl = make_rlock("utils.locks.test")
+    assert type(rl) is type(threading.RLock())
+
+
+def test_make_lock_checked_when_enabled(monkeypatch):
+    monkeypatch.setenv(LOCKCHECK_ENV, "1")
+    assert lockcheck_enabled()
+    lk = make_lock("utils.locks.test")
+    assert isinstance(lk, CheckedLock)
+    assert lk.name == "utils.locks.test"
+    assert isinstance(make_rlock("utils.locks.test"), CheckedRLock)
+
+
+def test_gate_is_creation_time(monkeypatch):
+    # flipping the env var does not re-instrument an existing lock
+    monkeypatch.delenv(LOCKCHECK_ENV, raising=False)
+    lk = make_lock("utils.locks.test")
+    monkeypatch.setenv(LOCKCHECK_ENV, "1")
+    assert not isinstance(lk, CheckedLock)
+
+
+# ----------------------------------------------------------------------
+# monitor recording
+
+def test_edges_and_acquisition_counts(mon):
+    a = CheckedLock("a", monitor=mon)
+    b = CheckedLock("b", monitor=mon)
+    with a:
+        with b:
+            pass
+    with a:
+        pass
+    doc = mon.report()
+    assert doc["edges"] == [["a", "b"]]
+    assert doc["locks"]["a"]["acquisitions"] == 2
+    assert doc["locks"]["b"]["acquisitions"] == 1
+    assert doc["order_violations"] == []
+
+
+def test_rank_inversion_recorded(mon):
+    a = CheckedLock("a", monitor=mon)
+    b = CheckedLock("b", monitor=mon)
+    with b:
+        with a:  # rank 1 acquired while rank 2 held: inversion
+            pass
+    doc = mon.report()
+    assert ["b", "a"] in doc["edges"]
+    [v] = doc["order_violations"]
+    assert v["lock"] == "a" and v["held"] == "b"
+    assert v["rank"] == 1 and v["held_rank"] == 2
+
+
+def test_equal_rank_is_a_violation():
+    mon = LockMonitor(clock=Clock(), ranks={"a": 5, "b": 5})
+    a = CheckedLock("a", monitor=mon)
+    b = CheckedLock("b", monitor=mon)
+    with a:
+        with b:
+            pass
+    assert len(mon.report()["order_violations"]) == 1
+
+
+def test_unregistered_lock_surfaces(mon):
+    x = CheckedLock("mystery", monitor=mon)
+    with x:
+        pass
+    doc = mon.report()
+    assert doc["unregistered"] == ["mystery"]
+    assert doc["order_violations"] == []  # unranked: no order claim
+
+
+def test_rlock_reentry(mon):
+    r = CheckedRLock("a", monitor=mon)
+    with r:
+        assert mon.held_depth("a") == 1
+        with r:
+            assert mon.held_depth("a") == 2
+        assert mon.held_depth("a") == 1
+    assert mon.held_depth("a") == 0
+    doc = mon.report()
+    assert doc["locks"]["a"]["acquisitions"] == 1
+    assert doc["locks"]["a"]["reentries"] == 1
+    assert doc["edges"] == []  # reentry is not an edge
+
+
+def test_blocking_event_on_long_hold(mon):
+    clock = mon.clock
+    a = CheckedLock("a", monitor=mon)
+    with a:
+        clock.advance(DEFAULT_BLOCKING_THRESHOLD_S * 4)
+    doc = mon.report()
+    [ev] = doc["blocking_events"]
+    assert ev["lock"] == "a"
+    assert ev["held_s"] == pytest.approx(
+        DEFAULT_BLOCKING_THRESHOLD_S * 4)
+    assert doc["locks"]["a"]["held_max_s"] == pytest.approx(
+        DEFAULT_BLOCKING_THRESHOLD_S * 4)
+
+
+def test_short_hold_is_not_blocking(mon):
+    a = CheckedLock("a", monitor=mon)
+    with a:
+        mon.clock.advance(DEFAULT_BLOCKING_THRESHOLD_S / 2)
+    assert mon.report()["blocking_events"] == []
+
+
+def test_cross_thread_contention(mon):
+    a = CheckedLock("a", monitor=mon)
+    entered = threading.Event()
+    done = threading.Event()
+
+    def worker():
+        entered.set()
+        with a:  # blocks until the main thread releases
+            pass
+        done.set()
+
+    a.acquire()
+    t = threading.Thread(target=worker, name="contender")
+    t.start()
+    entered.wait(5)
+    # give the worker time to miss the try-acquire and block for real
+    for _ in range(1000):
+        if mon.report()["locks"]["a"].get("contentions"):
+            break
+        t.join(0.001)
+    a.release()
+    assert done.wait(5)
+    t.join(5)
+    doc = mon.report()
+    assert doc["locks"]["a"]["contentions"] >= 1
+    assert doc["locks"]["a"]["acquisitions"] == 2
+    # held stacks are per-thread: no cross-thread edge, no violation
+    assert doc["edges"] == []
+    assert doc["order_violations"] == []
+
+
+def test_release_on_wrong_thread_is_flagged(mon):
+    mon.record_release("ghost")
+    [v] = mon.report()["order_violations"]
+    assert v["lock"] == "ghost"
+    assert "never acquired" in v["detail"]
+
+
+def test_try_acquire_nonblocking(mon):
+    a = CheckedLock("a", monitor=mon)
+    assert a.acquire()
+    got = [None]
+    t = threading.Thread(
+        target=lambda: got.__setitem__(0, a.acquire(blocking=False)))
+    t.start()
+    t.join(5)
+    assert got[0] is False  # a miss, not a deadlock
+    a.release()
+
+
+# ----------------------------------------------------------------------
+# report schema + globals
+
+def test_report_schema_validates(mon):
+    a = CheckedLock("a", monitor=mon)
+    with a:
+        pass
+    doc = mon.report()
+    validate_lockcheck_report(doc)  # must not raise
+    assert doc["lockcheck_schema_version"] == LOCKCHECK_SCHEMA_VERSION
+    # and it round-trips through JSON
+    validate_lockcheck_report(json.loads(json.dumps(doc)))
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda d: d.pop("edges"),
+    lambda d: d.update(lockcheck_schema_version=99),
+    lambda d: d.update(edges=[["only-one"]]),
+    lambda d: d.update(locks={"a": {}}),
+    lambda d: d.update(order_violations="nope"),
+])
+def test_report_schema_rejects(mon, mutate):
+    doc = mon.report()
+    mutate(doc)
+    with pytest.raises(ValueError):
+        validate_lockcheck_report(doc)
+
+
+def test_global_monitor_reset_and_report():
+    prev = global_monitor()
+    try:
+        m = reset_monitor(clock=Clock(), ranks={"x": 1})
+        assert global_monitor() is m
+        CheckedLock("x").acquire()  # no explicit monitor: uses global
+        doc = lockcheck_report()
+        validate_lockcheck_report(doc)
+        assert "x" in doc["locks"]
+        m.reset()
+        assert lockcheck_report()["locks"] == {}
+    finally:
+        reset_monitor()  # do not leak the test clock into the session
+
+
+# ----------------------------------------------------------------------
+# acceptance: seeded dispatch-chaos under CEPH_TPU_LOCKCHECK=1 agrees
+# with the static lock graph
+
+_CHAOS_CHILD = r'''
+import json
+import os
+
+import numpy as np
+
+from ceph_tpu.utils import locks
+assert locks.lockcheck_enabled(), "child needs CEPH_TPU_LOCKCHECK=1"
+
+from ceph_tpu.chaos import ShardErasure, inject
+from ceph_tpu.chaos.dispatch import DispatchFault, arm_plan, dispatch_faults
+from ceph_tpu.codes.registry import ErasureCodePluginRegistry
+from ceph_tpu.codes.stripe import HashInfo, StripeInfo
+from ceph_tpu.codes.stripe import encode as stripe_encode
+from ceph_tpu.ops import fallback
+from ceph_tpu.ops.supervisor import DispatchSupervisor, set_global_supervisor
+from ceph_tpu.parallel import plane
+from ceph_tpu.recovery.orchestrator import healed
+from ceph_tpu.scrub import repair_batched
+from ceph_tpu.utils.retry import FakeClock
+
+plane.set_data_plane(None)
+fallback.set_global_policy(fallback.FallbackPolicy(force=None))
+sup = DispatchSupervisor(clock=FakeClock(), self_verify=True,
+                         deadline_s=0.05, promote_after=2, probe_every=1)
+set_global_supervisor(sup)
+
+ec = ErasureCodePluginRegistry.instance().factory(
+    "jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"})
+n = ec.get_chunk_count()
+k = ec.get_data_chunk_count()
+sinfo = StripeInfo(k, k * 512)
+rng = np.random.default_rng(11)
+originals, stores, hinfos = [], [], []
+for i in range(4):
+    obj = rng.integers(0, 256, k * 512, np.uint8).tobytes()
+    shards = stripe_encode(sinfo, ec, obj)
+    hinfo = HashInfo(n)
+    hinfo.append(0, shards)
+    store, _ = inject(shards, [ShardErasure(shards=[i % 2])],
+                      seed=100 + i, chunk_size=sinfo.chunk_size)
+    originals.append(shards)
+    stores.append(store)
+    hinfos.append(hinfo)
+
+with dispatch_faults([DispatchFault("backend_loss",
+                                    seam="engine.fused_repair", at=2,
+                                    calls=None)], seed=12) as plan:
+    rep = repair_batched(sinfo, ec, stores, hinfos, device=True)
+    plan.clear()
+assert rep.pattern_batches == 2
+assert healed(stores, originals), "chaos scenario failed to heal"
+for _ in range(sup.promote_after + 1):
+    sup.tick()
+assert sup.stats()["repromotions"] >= 1
+arm_plan(None)
+
+print(json.dumps(locks.lockcheck_report()))
+'''
+
+
+def test_chaos_family_runtime_agrees_with_static_graph():
+    import os
+    env = dict(os.environ)
+    env.update({"CEPH_TPU_LOCKCHECK": "1", "JAX_PLATFORMS": "cpu"})
+    res = subprocess.run([sys.executable, "-c", _CHAOS_CHILD],
+                         capture_output=True, text=True,
+                         cwd=str(REPO_ROOT), env=env, timeout=600)
+    assert res.returncode == 0, res.stderr[-4000:]
+    doc = json.loads(res.stdout.strip().splitlines()[-1])
+    validate_lockcheck_report(doc)
+    assert doc["enabled"] is True
+    # the scenario exercised real locks...
+    assert doc["locks"], "no lock activity recorded"
+    assert "ops.supervisor.DispatchSupervisor._lock" in doc["locks"]
+    # ...with the discipline the static tier proved: every runtime
+    # held->acquired edge is predicted by the static graph, nothing
+    # inverts the declared order, and no hold crossed the blocking
+    # threshold (the runtime face of conc-blocking-under-lock)
+    from ceph_tpu.analysis.concurrency import static_lock_graph
+    static = {tuple(e) for e in
+              static_lock_graph([str(REPO_ROOT / "ceph_tpu")])["edges"]}
+    runtime = {tuple(e) for e in doc["edges"]}
+    assert runtime <= static, f"unpredicted edges: {runtime - static}"
+    assert doc["order_violations"] == []
+    assert doc["blocking_events"] == []
+    # every lock the scenario touched is in the lockmodel registry
+    assert doc["unregistered"] == []

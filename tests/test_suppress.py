@@ -1,0 +1,152 @@
+"""analysis/suppress.py edge cases (ISSUE 16 satellite).
+
+The pragma machinery is shared by all three static tiers (AST, trace,
+conc), so its corner behavior — multi-rule pragmas with per-rule
+staleness, ``disable=all``, block-scoped suppression over multi-line
+spans, tokenize-grade extraction — is pinned here once rather than
+re-tested per tier.
+"""
+
+import textwrap
+
+from ceph_tpu.analysis.suppress import (
+    PragmaInfo,
+    Suppression,
+    collect_pragmas,
+)
+
+
+def _collect(src: str) -> PragmaInfo:
+    return collect_pragmas(textwrap.dedent(src))
+
+
+# ----------------------------------------------------------------------
+# Suppression matching / staleness grain
+
+def test_multi_rule_pragma_matches_each_listed_rule():
+    s = Suppression({"gf-float-dtype", "conc-unguarded-write"}, 5,
+                    "mixed")
+    assert s.matches("gf-float-dtype", 5, 5)
+    assert s.matches("conc-unguarded-write", 5, 5)
+    assert not s.matches("other-rule", 5, 5)
+
+
+def test_multi_rule_pragma_is_half_stale():
+    # a pragma listing two rules where only one still fires: the
+    # other is stale, per-rule (not the whole pragma)
+    s = Suppression({"rule-a", "rule-b"}, 5, "two birds")
+    s.record_use("rule-a")
+    assert s.used
+    assert s.stale_rules() == {"rule-b"}
+    s.record_use("rule-b")
+    assert s.stale_rules() == set()
+
+
+def test_disable_all_matches_any_rule_and_staleness_is_whole():
+    s = Suppression({"all"}, 3, "generated code")
+    assert s.matches("anything-at-all", 3, 3)
+    assert s.stale_rules() == {"all"}  # nothing matched yet
+    s.record_use("some-rule")
+    assert s.used_rules == {"all"}
+    assert s.stale_rules() == set()
+
+
+def test_block_scoped_suppression_spans_multiline_findings():
+    # a finding spanning lines 4..9 is suppressed by a pragma on ANY
+    # covered line — the conc tier anchors unguarded-write findings on
+    # the write statement but blocking findings on multi-line calls
+    s = Suppression({"conc-blocking-under-lock"}, 6, "span")
+    assert s.matches("conc-blocking-under-lock", 4, 9)
+    assert not s.matches("conc-blocking-under-lock", 7, 9)
+    assert not s.matches("conc-blocking-under-lock", 1, 5)
+
+
+def test_file_wide_suppression_matches_everywhere():
+    s = Suppression({"rule-a"}, 0, "whole file")
+    assert s.matches("rule-a", 1, 1)
+    assert s.matches("rule-a", 9999, 9999)
+
+
+# ----------------------------------------------------------------------
+# collect_pragmas extraction
+
+def test_trailing_pragma_applies_to_its_own_line():
+    info = _collect('''
+        x = 1
+        y = compute()  # tpu-lint: disable=rule-a,conc-lock-cycle -- both tiers
+    ''')
+    [s] = info.suppressions
+    assert s.rules == {"rule-a", "conc-lock-cycle"}
+    assert s.line == 3
+    assert s.reason == "both tiers"
+
+
+def test_standalone_pragma_applies_to_next_code_line():
+    info = _collect('''
+        # tpu-lint: disable=conc-unguarded-write -- init pattern
+        # another comment in between
+        x = write()
+    ''')
+    [s] = info.suppressions
+    assert s.line == 4
+
+
+def test_standalone_pragma_skips_blank_and_comment_lines():
+    info = _collect('''
+        # tpu-lint: disable=rule-a -- below
+
+        # interleaved comment
+
+        target = 1
+    ''')
+    assert info.suppressions[0].line == 6
+
+
+def test_disable_file_is_line_zero():
+    info = _collect('''
+        # tpu-lint: disable-file=conc-registry-gap -- vendored
+        x = 1
+    ''')
+    [s] = info.suppressions
+    assert s.line == 0
+    assert s.matches("conc-registry-gap", 500, 500)
+
+
+def test_pragma_in_string_literal_is_ignored():
+    info = _collect('''
+        doc = "# tpu-lint: disable=all -- not a real pragma"
+    ''')
+    assert info.suppressions == []
+
+
+def test_missing_reason_is_empty_string():
+    info = _collect('''
+        x = 1  # tpu-lint: disable=rule-a
+    ''')
+    assert info.suppressions[0].reason == ""
+
+
+def test_broken_source_yields_no_pragmas():
+    info = collect_pragmas("def broken(:\n  # tpu-lint: disable=all\n")
+    assert info.suppressions == []
+
+
+def test_scope_and_jit_function_pragmas():
+    info = _collect('''
+        # tpu-lint: scope=gf
+        # tpu-lint: jit-function
+        def kernel():
+            pass
+    ''')
+    assert info.scope_override == "gf"
+    assert 4 in info.jit_function_lines
+
+
+def test_suppression_for_records_use():
+    info = _collect('''
+        x = 1  # tpu-lint: disable=rule-a,rule-b -- why
+    ''')
+    hit = info.suppression_for("rule-a", 2, 2)
+    assert hit is not None and hit.used_rules == {"rule-a"}
+    assert info.suppression_for("rule-c", 2, 2) is None
+    assert hit.stale_rules() == {"rule-b"}

@@ -12,6 +12,14 @@ cd "$(dirname "$0")/.."
 # fails the run on stale `# tpu-lint: disable=` pragmas.
 python tools/tpu_lint.py --check-suppressions ceph_tpu/ tools/ bench.py \
     || exit 1
+# Concurrency gate (conc tier, docs/LINT.md): lock discovery, guard
+# inference, the conc-* rules and the lockmodel rank registry
+# cross-check — pure AST, jax-free, seconds.  --check-suppressions
+# also fails on stale `conc-*` pragmas (the AST gate above skips
+# them: conc pragmas are this tier's to judge).  The runtime half
+# (CEPH_TPU_LOCKCHECK=1) runs inside tier-1 as tests/test_lockcheck.py.
+python tools/tpu_lint.py --conc --check-suppressions ceph_tpu/ tools/ \
+    bench.py || exit 1
 # Trace gate second (ISSUE 5): tpu-audit traces every registered
 # jit-facing entry point (analysis/entrypoints.py) to a jaxpr, runs
 # the audit-* rules + the recompile sentinel, and fails if a public

@@ -10,11 +10,12 @@ Usage:
     python tools/tpu_lint.py --trace           # jaxpr audit (needs jax)
     python tools/tpu_lint.py --trace --entry clay.decode_chunks_jax
     python tools/tpu_lint.py --list-entrypoints
+    python tools/tpu_lint.py --conc ceph_tpu/  # lock/race analysis
 
 Exit status: 0 when no unsuppressed findings, 1 otherwise.  Rules,
 suppression syntax (`# tpu-lint: disable=<rule> -- reason`) and the
-three-tier static→trace→runtime sanitizer story are documented in
-docs/LINT.md.
+four-tier static→trace→conc→runtime sanitizer story are documented
+in docs/LINT.md.
 
 The AST tier is pure stdlib-ast analysis: it never imports the scanned
 code, so it runs in any environment (no jax needed).  `--trace` runs
@@ -23,7 +24,11 @@ the jaxpr audit over the entry-point registry
 traces every registered jit-facing entry point, walks the jaxprs
 against the audit-* rules, runs the recompile sentinel, and fails if
 any public plugin device surface is missing from the registry.
-`--check-suppressions` flags stale pragmas on either tier.
+`--conc` runs the concurrency tier (analysis/concurrency.py): lock
+discovery, guard-set inference, the conc-* rules, and the lock-order
+registry cross-check against analysis/lockmodel.py — also pure AST,
+also jax-free.  `--check-suppressions` flags stale pragmas on any
+tier.
 """
 
 import argparse
@@ -81,6 +86,22 @@ def _run_trace(args) -> int:
     return 0 if report.ok and not stale else 1
 
 
+def _run_conc(args) -> int:
+    from ceph_tpu.analysis.concurrency import lint_conc_paths
+
+    report = lint_conc_paths(
+        args.paths or _default_paths(),
+        check_suppressions=args.check_suppressions)
+    if args.json:
+        print(render_json(report, tier="conc"))
+    else:
+        print(render_human(report, show_suppressed=args.show_suppressed,
+                           show_stale=args.check_suppressions,
+                           label="tpu-conc"))
+    ok = report.ok and not (args.check_suppressions and report.stale)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpu-lint",
@@ -102,6 +123,10 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="run the jaxpr trace tier over the entry-point "
                          "registry (imports jax)")
+    ap.add_argument("--conc", action="store_true",
+                    help="run the concurrency tier (lock discovery, "
+                         "guard inference, conc-* rules, lockmodel "
+                         "registry cross-check; jax-free)")
     ap.add_argument("--entry", action="append", default=None,
                     metavar="NAME",
                     help="with --trace: audit only these entry points")
@@ -124,6 +149,8 @@ def main(argv=None) -> int:
         return 0
     if args.trace:
         return _run_trace(args)
+    if args.conc:
+        return _run_conc(args)
 
     paths = args.paths or _default_paths()
     config = LintConfig(
