@@ -151,7 +151,20 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # (CEPH_TPU_LOCKCHECK=1, utils/locks.py) was live for the run, since
 # checked locks add bookkeeping per acquire and such rows must never
 # be compared against production numbers.
-METRIC_VERSION = 13
+# v14 (ISSUE 17, host fault domains): a `host_chaos_rows` section —
+# batched recovery through the supervised fused-repair seam while a
+# seeded HostLoss (--workload host-chaos; chaos/hosts.py + the
+# host-aware plane) takes a whole simulated host fault domain out
+# mid-run: the supervisor reshrinks host-granular, runs the
+# journal-reclaim hook, and re-promotes to full host width once the
+# plan clears.  The row's GB/s is recovery-under-host-loss throughput
+# (the bench_diff `host_chaos` category) and it carries the
+# host-granular counter deltas (host_quarantines, host_repromotions,
+# journal_redispatches) plus the plane's host topology.  On the
+# tunnel-down error path the same loop runs host-only (no plane: the
+# process is its one fault domain, so the loss demotes to the
+# ground-truth twin — the width-1 ladder).
+METRIC_VERSION = 14
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -330,6 +343,30 @@ DEVICE_CHAOS_ROW_FIELDS = ("supervisor", "faults_fired",
                            "demoted_at_end", "erasures", "verified")
 
 
+# Host-chaos rows (ISSUE 17): batched recovery through the supervised
+# fused-repair seam while a seeded HostLoss takes a whole simulated
+# host fault domain out mid-run — host-granular reshrink (the
+# survivor keeps its devices), journal-reclaim hook, health-probe
+# re-promotion to full host width.  Byte-identical heal and zero data
+# loss gate in-workload; the GB/s is the bench_diff `host_chaos`
+# series.  The tunnel-down error path re-pins --device host (argparse
+# last-wins): no plane forms, so the loss of host 0 demotes to the
+# ground-truth twin — the width-1 ladder stays measured through an
+# outage.
+HOST_CHAOS_ROWS = [
+    ("rs_k8_m3_host_chaos",
+     ["--plugin", "jerasure", "--parameter", "technique=reed_sol_van",
+      "--parameter", "k=8", "--parameter", "m=3",
+      "--size", str(1 << 19), "--workload", "host-chaos",
+      "--device", "jax", "--batch", "8", "--iterations", "2",
+      "--hosts", "2", "-e", "1", "--seed", "42"]),
+]
+
+HOST_CHAOS_ROW_FIELDS = ("supervisor", "faults_fired",
+                         "reclaim_calls", "demoted_at_end", "hosts",
+                         "erasures", "verified")
+
+
 # Autotune rows (ISSUE 14): the profiler-driven config sweep for the
 # north-star shape — timed min-of-N candidate dispatches (device),
 # the host-only analytic roofline sweep on the tunnel-down error path
@@ -385,6 +422,25 @@ def _device_chaos_rows(host_only: bool = False) -> dict:
         except Exception as e:  # noqa: BLE001 - recorded, never fatal
             rows[name] = None
             print(f"device-chaos/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
+
+
+def _host_chaos_rows(host_only: bool = False) -> dict:
+    rows = {}
+    for name, argv in HOST_CHAOS_ROWS:
+        row_argv = list(argv)
+        if host_only:
+            row_argv += ["--device", "host", "--iterations", "1"]
+        try:
+            res = _run(row_argv)
+            row = _row_result(res)
+            for f in HOST_CHAOS_ROW_FIELDS:
+                row[f] = res.get(f)
+            rows[name] = row
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"host-chaos/{name}: {type(e).__name__}: {e}",
                   file=sys.stderr)
     return rows
 
@@ -686,6 +742,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "profile_rows": _profile_rows(host_only=True),
         "scenario_rows": _scenario_rows(host_only=True, requests=64),
         "device_chaos_rows": _device_chaos_rows(host_only=True),
+        "host_chaos_rows": _host_chaos_rows(host_only=True),
         "autotune_rows": _autotune_rows(host_only=True),
         "last_good": _read_last_good(),
         "supervisor": _supervisor_blob(),
@@ -899,6 +956,7 @@ def main() -> int:
         "profile_rows": _profile_rows(),
         "scenario_rows": _scenario_rows(),
         "device_chaos_rows": _device_chaos_rows(),
+        "host_chaos_rows": _host_chaos_rows(),
         "autotune_rows": _autotune_rows(),
         "lat_p50_ms": best.get("lat_p50_ms"),
         "lat_p99_ms": best.get("lat_p99_ms"),

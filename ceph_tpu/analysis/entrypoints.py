@@ -52,7 +52,7 @@ from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
 # topology-generated map + the rateless over-planned dispatch)
 FAMILIES = ("jerasure", "isa", "shec", "lrc", "clay",
             "engine", "ops", "crush", "scrub", "telemetry", "serve",
-            "cluster", "scenario", "tune")
+            "cluster", "scenario", "tune", "chaos")
 
 # public device surfaces a plugin family can expose; the completeness
 # check requires every one present on a family's representative
@@ -466,6 +466,42 @@ def _build_serve_dispatch_sharded() -> Built:
                  serve_dispatch_call)
 
 
+def _mesh_plane_hosts():
+    """The all-device plane split into host fault domains (ISSUE 17):
+    same mesh, host-major partition.  Falls back to one domain when
+    the device count cannot halve (the bare single-device audit)."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+    from ..parallel.plane import DataPlane
+
+    n = len(jax.devices())
+    h = 2 if n >= 2 and n % 2 == 0 else 1
+    return DataPlane(make_mesh(n, tp=1), hosts=h)
+
+
+def _build_fused_repair_host_sharded() -> Built:
+    """The fused repair program sharded over a HOST-PARTITIONED plane
+    (ISSUE 17), on its own erasure pattern so it audits its own cache
+    row: the host split is dispatch-plane bookkeeping only, so the
+    program must stay primitive-identical to the single-domain
+    sharded build (GF_SHARD_PRIMS) and the warm == 0 sentinel pins
+    that spanning fault domains never recompiles."""
+    import numpy as np
+
+    from ..codes.engine import fused_repair_call
+
+    ec = representative_instance("jerasure")
+    n = ec.get_chunk_count()
+    erased = (2,)
+    available = tuple(i for i in range(n) if i != 2)
+    fn = fused_repair_call(ec, available, erased,
+                           mesh=_mesh_plane_hosts())
+    return Built(fn, (np.zeros((_SHARD_B, len(available), C),
+                               np.uint8),),
+                 fused_repair_call)
+
+
 def _build_apply_matrix_best_sharded() -> Built:
     import numpy as np
 
@@ -743,6 +779,20 @@ def _build_supervisor_selftest() -> Built:
     return Built(supervisor_selftest, (), supervisor_selftest)
 
 
+def _build_host_chaos_selftest() -> Built:
+    """The host fault-domain survival arc as a host-tier entry
+    (ISSUE 17): a seeded HostLoss against an isolated supervisor —
+    host-granular reshrink, journal-reclaim hook, health-probe
+    re-promotion restoring the original topology (or, on a
+    single-device floor, the planeless demote-to-twin ladder) — on
+    pure-numpy callables: ZERO jax compiles, zero device arrays,
+    forever.  The plane bookkeeping (mesh build, activate/restore) is
+    the only jax surface and it compiles nothing."""
+    from ..chaos.hosts import host_chaos_selftest
+
+    return Built(host_chaos_selftest, (), host_chaos_selftest)
+
+
 def _build_fused_repair_supervised() -> Built:
     """The supervised fused-repair seam as a jit-tier entry: the SAME
     cached decode→re-encode program under the supervisor's eager
@@ -881,6 +931,13 @@ def registry() -> Tuple[EntryPoint, ...]:
         EntryPoint("serve.dispatch_sharded", "serve", "jit",
                    _build_serve_dispatch_sharded, allow=GF_SHARD_PRIMS,
                    trace_budget=16),
+        # host fault domains (ISSUE 17): the same sharded repair
+        # program over a host-partitioned plane — the domain split is
+        # bookkeeping, so primitives and warm-compile count must not
+        # move; the survival arc itself is the host-tier entry below
+        EntryPoint("engine.fused_repair_host_sharded", "engine", "jit",
+                   _build_fused_repair_host_sharded,
+                   allow=GF_SHARD_PRIMS, trace_budget=16),
         EntryPoint("ops.apply_matrix_best_sharded", "ops", "jit",
                    _build_apply_matrix_best_sharded,
                    allow=GF_SHARD_PRIMS, trace_budget=16),
@@ -941,6 +998,13 @@ def registry() -> Tuple[EntryPoint, ...]:
         # would mean supervision leaked into the jaxpr
         EntryPoint("ops.supervisor", "ops", "host",
                    _build_supervisor_selftest, allow=None,
+                   trace_budget=0),
+        # the host fault-domain survival arc (ISSUE 17): loss ->
+        # host-granular reshrink -> journal reclaim -> re-promotion,
+        # all host control flow forever — 0 compiles, 0 device arrays
+        # (the recovery plane must not need the thing that just died)
+        EntryPoint("chaos.host_plane", "chaos", "host",
+                   _build_host_chaos_selftest, allow=None,
                    trace_budget=0),
         EntryPoint("engine.fused_repair_supervised", "engine", "jit",
                    _build_fused_repair_supervised, allow=GF_XLA_PRIMS,

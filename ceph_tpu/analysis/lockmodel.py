@@ -81,6 +81,10 @@ LOCKS: Tuple[LockSpec, ...] = (
              "active fault-plan install/uninstall"),
     LockSpec("chaos.dispatch.DispatchFaultPlan._lock", "chaos.dispatch",
              260, "lock", "fault schedule cursor + fired-fault log"),
+    LockSpec("chaos.hosts._lock", "chaos.hosts", 252, "lock",
+             "active host-fault-plan install/uninstall"),
+    LockSpec("chaos.hosts.HostFaultPlan._lock", "chaos.hosts", 262,
+             "lock", "host-fault schedule cursor + fired-fault log"),
     LockSpec("tune.table._lock", "tune.table", 270, "lock",
              "active best-config table install + generation counter"),
     LockSpec("tune.table.BestConfigTable._lock", "tune.table", 280,

@@ -134,7 +134,8 @@ class ErasureCodeBench:
                                  "repair-batched", "recovery-churn",
                                  "serving", "multichip", "cluster",
                                  "profile", "scenario",
-                                 "device-chaos", "autotune"])
+                                 "device-chaos", "host-chaos",
+                                 "autotune"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
         ap.add_argument("-s", "--size", type=int, default=1 << 20,
                         help="object size (bytes) per stripe")
@@ -240,6 +241,11 @@ class ErasureCodeBench:
                              "dump role) to stderr after the run")
         ap.add_argument("--profile-dir", default=None,
                         help="record a jax.profiler device trace here")
+        ap.add_argument("--hosts", type=int, default=2,
+                        help="simulated host fault domains the "
+                             "host-chaos workload spans the plane "
+                             "over (clamped to what the visible "
+                             "devices can halve into)")
         ap.add_argument("--seed", type=int, default=42)
         self.args = ap.parse_args(argv)
         if self.args.iterations < 1:
@@ -1592,6 +1598,164 @@ class ErasureCodeBench:
         res["verified"] = True
         return res
 
+    # -- host-chaos (a whole host fault domain drops mid-repair:
+    # recovery-under-host-loss throughput — ISSUE 17, chaos/hosts.py +
+    # the host-aware plane) ---------------------------------------------
+
+    def host_chaos(self) -> dict:
+        """Recovery throughput while a whole HOST fault domain fails
+        mid-run: the same batched fused-repair stream as device-chaos,
+        but the plane spans ``--hosts`` simulated fault domains and a
+        seeded HostLoss (chaos/hosts.py) takes the last one out at the
+        seam's Nth call.  The supervisor must classify ``host_loss``,
+        reshrink host-granular (the survivor keeps all its devices),
+        run the journal-reclaim hook, complete the batch, and
+        re-promote to full host width once the plan clears — zero
+        data loss and byte-identical heal are gated in-workload, and
+        the row carries the host-granular counter deltas so
+        bench_diff's ``host_chaos`` category can never silently
+        regress host-loss survival.
+
+        ``--device host`` (the tunnel-down error path): no plane
+        forms, so the process is its one fault domain — losing host 0
+        demotes straight to the ground-truth twin (the width-1
+        ladder), measuring the classification machinery without
+        touching a wedged device."""
+        from ..chaos import BitFlip, ShardErasure, inject
+        from ..chaos.hosts import (HostFaultPlan, HostLoss,
+                                   arm_host_plan)
+        from ..codes.stripe import HashInfo, StripeInfo
+        from ..codes.stripe import encode as stripe_encode
+        from ..ops.supervisor import global_supervisor
+        from ..parallel import plane as planemod
+        from ..recovery.orchestrator import healed
+        from ..scrub import repair_batched
+        a = self.args
+        ec = self._instance()
+        n = ec.get_chunk_count()
+        k = ec.get_data_chunk_count()
+        if a.erasures < 1 or a.erasures + a.corruptions >= n:
+            raise ValueError("host-chaos needs 1 <= erasures + "
+                             "corruptions < n")
+        chunk_size = ec.get_chunk_size(a.size)
+        width = k * chunk_size
+        sinfo = StripeInfo(k, width)
+        rng = np.random.default_rng(a.seed)
+        objects = []
+        for i in range(a.batch):
+            obj = rng.integers(0, 256, size=width,
+                               dtype=np.uint8).tobytes()
+            shards = stripe_encode(sinfo, ec, obj)
+            hinfo = HashInfo(n)
+            hinfo.append(0, shards)
+            objects.append((shards, hinfo))
+        hinfos = [h for _, h in objects]
+        originals = [s for s, _ in objects]
+
+        prng = np.random.default_rng(a.seed + 1)
+        n_pat = max(1, min(4, a.batch))
+        pool = []
+        for _ in range(n_pat):
+            victims = prng.choice(n, size=a.erasures + a.corruptions,
+                                  replace=False)
+            pool.append(([int(v) for v in victims[:a.erasures]],
+                         [int(v) for v in victims[a.erasures:]]))
+
+        def make_stores():
+            stores = []
+            for i, (shards, _) in enumerate(objects):
+                erased, flipped = pool[i % n_pat]
+                inj = []
+                if erased:
+                    inj.append(ShardErasure(shards=list(erased)))
+                if flipped:
+                    inj.append(BitFlip(shards=list(flipped), flips=1))
+                st, _ = inject(shards, inj, seed=a.seed + i,
+                               chunk_size=sinfo.chunk_size)
+                stores.append(st)
+            return stores
+
+        dev = a.device != "host"
+        sup = global_supervisor()
+        seam = ("engine.fused_repair" if dev else "bench.host_chaos")
+        prev_plane = None
+        plane = None
+        if dev:
+            prev_plane = planemod.data_plane()
+            plane = planemod.activate(None, hosts=max(2, a.hosts))
+        hosts0 = plane.hosts if plane is not None else 1
+        # the victim: the LAST host domain (host 0 when no plane can
+        # form — the process itself is the one fault domain)
+        lost = hosts0 - 1 if hosts0 > 1 else 0
+        reclaims: List[str] = []
+        prev_reclaim = sup.set_inflight_reclaim(
+            lambda s: reclaims.append(s) or 0)
+
+        def fault_script():
+            return HostFaultPlan(
+                [HostLoss(lost, seam=seam, at=(2 if dev else 1),
+                          calls=2)],
+                seed=a.seed)
+
+        def run_once():
+            stores = make_stores()
+            if dev:
+                rep = repair_batched(sinfo, ec, stores, hinfos,
+                                     device=True)
+            else:
+                call = (lambda: repair_batched(
+                    sinfo, ec, stores, hinfos, device=False))
+                rep = sup.dispatch(seam, lambda: call(), (),
+                                   host_fn=lambda: call(),
+                                   splittable=False)
+            if not healed(stores, originals):
+                raise RuntimeError("host-chaos: data loss under "
+                                   "injected host loss")
+            return rep
+
+        try:
+            # warm pattern caches + traces with NO faults armed
+            run_once()
+            before = {key: v for key, v in sup.stats().items()
+                      if isinstance(v, int)}
+            lat = _LatTimer()
+            plans = []
+            begin = time.perf_counter()
+            for _ in range(a.iterations):
+                plan = fault_script()
+                prev = arm_host_plan(plan)
+                try:
+                    lat.run(run_once)
+                    plan.clear()
+                    # drive the health probe to re-promotion so every
+                    # iteration starts at full host width
+                    for _ in range(sup.promote_after + 2):
+                        sup.tick()
+                finally:
+                    arm_host_plan(prev)
+                plans.append(plan.summary())
+            elapsed = time.perf_counter() - begin
+            after = sup.stats()
+        finally:
+            sup.set_inflight_reclaim(prev_reclaim)
+            if dev:
+                planemod.set_data_plane(prev_plane)
+        res = self._result("host-chaos", elapsed,
+                           width * a.batch * a.iterations, lat)
+        res["erasures"] = a.erasures
+        res["hosts"] = hosts0
+        res["supervisor"] = {
+            key: after[key] - before.get(key, 0)
+            for key in ("host_quarantines", "host_repromotions",
+                        "journal_redispatches", "retries",
+                        "demotions", "quarantines", "repromotions",
+                        "host_completions")}
+        res["faults_fired"] = sum(p["fired"] for p in plans)
+        res["reclaim_calls"] = len(reclaims)
+        res["demoted_at_end"] = after["demoted"]
+        res["verified"] = True
+        return res
+
     # -- autotune (the roofline-closing config search as a measured
     # workload — ISSUE 14, ceph_tpu/tune/ + tools/autotune.py) ---------
 
@@ -1665,6 +1829,8 @@ class ErasureCodeBench:
             return self.scenario_workload()
         if self.args.workload == "device-chaos":
             return self.device_chaos()
+        if self.args.workload == "host-chaos":
+            return self.host_chaos()
         return self.decode()
 
 

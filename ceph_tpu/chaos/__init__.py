@@ -8,7 +8,10 @@ OSDMap churn through epoch-ordered incrementals) the recovery
 orchestrator must survive, and the device-plane DispatchFault family
 (chaos/dispatch.py: transient/OOM/backend-loss/hang/corrupt armed per
 (seam, Nth call)) the supervised dispatch plane (ops/supervisor.py)
-must classify and absorb.  The scrub pipeline (ceph_tpu.scrub), the
+must classify and absorb, and the host-domain adversaries
+(chaos/hosts.py: HostLoss/HostFlap/HostPartition) the host-aware data
+plane must survive with a host-granular reshrink and journal-backed
+re-dispatch.  The scrub pipeline (ceph_tpu.scrub), the
 recovery orchestrator (ceph_tpu.recovery), the fuzz/torture suites,
 the degraded benchmark rows and tools/{scrub,recovery}_demo.py all
 drive the same adversaries, so every robustness claim replays from a
@@ -27,6 +30,15 @@ from .dispatch import (  # noqa: F401
     DispatchFault,
     DispatchFaultPlan,
     dispatch_faults,
+)
+from .hosts import (  # noqa: F401
+    HOST_FAULT_KINDS,
+    HostFault,
+    HostFaultPlan,
+    HostFlap,
+    HostLoss,
+    HostPartition,
+    host_faults,
 )
 from .injectors import (  # noqa: F401
     BitFlip,

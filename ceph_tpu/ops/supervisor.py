@@ -33,6 +33,12 @@ persistent loss     LIVE ``FallbackPolicy.demote()`` down the
 mesh-member loss    device quarantine: the data plane reshrinks
                     8 → 4 → 2 → 1 → single-device (never silently to
                     host) and the seam's sharded program rebuilds
+host loss           host quarantine (ISSUE 17): the plane reshrinks
+                    HOST-granular — hosts 4 → 2 → 1 (every device the
+                    lost domain contributed at once), then the device
+                    ladder inside the survivor — and in-flight intents
+                    journaled for the lost host replay epoch-fenced
+                    onto the shrunken plane (``set_inflight_reclaim``)
 hang                clock-injectable dispatch deadline; a dispatch
                     that burns past it is classified as backend loss
 output corruption   (self-verify mode) outputs are CRC-checked
@@ -71,7 +77,8 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from ..utils.errors import RetryExhausted, TransientBackendError
+from ..utils.errors import (ProbeTimeout, RetryExhausted,
+                            TransientBackendError)
 from ..utils.log import dout
 from ..utils.retry import RetryPolicy, SystemClock, retry_call
 from ..utils.locks import make_lock
@@ -83,6 +90,13 @@ _OOM_MARKERS = ("resource_exhausted", "out of memory", "hbm oom")
 _LOSS_MARKERS = ("unavailable", "backend", "tunnel", "connection",
                  "socket closed", "deadline_exceeded",
                  "failed_precondition")
+# a whole fault domain gone, not one chip: PJRT/coordination-service
+# phrasings for a peer process dropping out (checked BEFORE the
+# generic loss markers — "host unreachable" also contains no generic
+# marker, but a mixed message must classify at host granularity)
+_HOST_MARKERS = ("host unreachable", "worker lost", "peer down",
+                 "process exited", "coordination service",
+                 "slice health")
 
 # escalation ceiling per dispatch: transient-exhausted -> demote(xla)
 # -> demote(numpy)/quarantine ladder can never loop
@@ -95,12 +109,14 @@ _HOST = object()        # _escalate verdict: complete on the host twin
 
 def classify_dispatch_error(e: BaseException) -> Optional[str]:
     """Map a dispatch-seam exception to a supervised class —
-    ``"transient"`` / ``"oom"`` / ``"backend_loss"`` — or None for
-    errors that are NOT the backend's fault (a shape error, a plugin
-    contract violation): those propagate untouched, because retrying
-    or demoting a genuine bug would only hide it."""
+    ``"transient"`` / ``"oom"`` / ``"backend_loss"`` /
+    ``"host_loss"`` — or None for errors that are NOT the backend's
+    fault (a shape error, a plugin contract violation): those
+    propagate untouched, because retrying or demoting a genuine bug
+    would only hide it."""
     from ..chaos.dispatch import (DispatchHang, InjectedBackendLoss,
                                   InjectedOom)
+    from ..chaos.hosts import InjectedHostLoss, InjectedHostPartition
     if isinstance(e, RetryExhausted):
         inner = (classify_dispatch_error(e.last)
                  if e.last is not None else None)
@@ -109,12 +125,21 @@ def classify_dispatch_error(e: BaseException) -> Optional[str]:
         return "transient"
     if isinstance(e, InjectedOom):
         return "oom"
+    if isinstance(e, (InjectedHostLoss, InjectedHostPartition)):
+        return "host_loss"
+    if isinstance(e, ProbeTimeout):
+        # a probe that burned its deadline is the HANG class (the
+        # probed endpoint is wedged, not flaky): backend loss, so the
+        # ladder acts — never the transient retry loop
+        return "backend_loss"
     if isinstance(e, (InjectedBackendLoss, DispatchHang)):
         return "backend_loss"
     if isinstance(e, (RuntimeError, OSError, ConnectionError)):
         msg = str(e).lower()
         if any(m in msg for m in _OOM_MARKERS):
             return "oom"
+        if any(m in msg for m in _HOST_MARKERS):
+            return "host_loss"
         if any(m in msg for m in _LOSS_MARKERS):
             return "backend_loss"
     return None
@@ -196,12 +221,20 @@ class DispatchSupervisor:
         self._floor: Optional[str] = None      # "numpy" once demoted
         self._tier_demotions = 0
         self._plane_width0: Optional[int] = None
+        self._plane_hosts0: Optional[int] = None
         self._clean_probes = 0
         self._since_probe = 0
         self._verify_seq = 0
+        # journal-backed in-flight reclaim (ISSUE 17): the recovery
+        # layer registers a callback that replays the lost host's
+        # intent records onto the shrunken plane after a host
+        # quarantine (set_inflight_reclaim)
+        self._inflight_reclaim: Optional[Callable[[str], int]] = None
         self.counters: Dict[str, int] = {
             "dispatches": 0, "retries": 0, "rung_downshifts": 0,
             "demotions": 0, "quarantines": 0, "repromotions": 0,
+            "host_quarantines": 0, "host_repromotions": 0,
+            "journal_redispatches": 0,
             "hangs": 0, "slow_dispatches": 0, "host_completions": 0,
             "verify_failures": 0, "verified_clean": 0,
             "injected_faults": 0, "probe_clean": 0, "probe_failed": 0,
@@ -242,8 +275,22 @@ class DispatchSupervisor:
             out["tier_floor"] = self._floor
             out["tier_demotions"] = self._tier_demotions
             out["plane_width0"] = self._plane_width0
+            out["plane_hosts0"] = self._plane_hosts0
             out["clean_probes"] = self._clean_probes
         return out
+
+    def set_inflight_reclaim(
+            self, cb: Optional[Callable[[str], int]]
+    ) -> Optional[Callable[[str], int]]:
+        """Register the journal-backed in-flight reclaim hook: after a
+        host quarantine, ``cb(seam)`` replays the lost host's intent
+        records (recovery/journal.py, epoch-fenced) onto the shrunken
+        plane and returns how many ops were re-dispatched.  Returns
+        the previous hook so callers can restore it."""
+        with self._lock:
+            prev = self._inflight_reclaim
+            self._inflight_reclaim = cb
+        return prev
 
     def reset_pacing(self) -> None:
         """Zero the probe/verify pacing counters WITHOUT touching the
@@ -265,6 +312,7 @@ class DispatchSupervisor:
             self._floor = None
             self._tier_demotions = 0
             self._plane_width0 = None
+            self._plane_hosts0 = None
             self._clean_probes = 0
             self._since_probe = 0
             self._verify_seq = 0
@@ -294,14 +342,20 @@ class DispatchSupervisor:
         ladder the exact host mapper resolves in one step).
         """
         from ..chaos.dispatch import active_plan
+        from ..chaos.hosts import active_host_plan
         self._count("dispatches")
         plan = active_plan()
         if self._floor == "numpy" and host_fn is not None:
             # the backend is gone: the seam call still advances the
-            # chaos plan's windows (so a timed fault can clear), then
-            # the ground-truth twin completes the dispatch
+            # chaos plans' windows (so a timed fault can clear), then
+            # the ground-truth twin completes the dispatch.  hosts=0:
+            # there is no plane to land on, so a host fault cannot
+            # fire — but flap timelines stay aligned
             if plan is not None:
                 plan.poll(seam)
+            hplan = active_host_plan()
+            if hplan is not None:
+                hplan.poll(seam, 0)
             out = self._host_complete(seam, host_fn, args)
             self._after_dispatch()
             return out
@@ -333,7 +387,7 @@ class DispatchSupervisor:
                     raise
                 verdict = self._escalate(seam, e, cur_fn,
                                          rebuild=rebuild,
-                                         host_fn=host_fn)
+                                         host_fn=host_fn, cls=cls)
                 if verdict is _HOST:
                     out = self._host_complete(seam, host_fn, args)
                     break
@@ -352,6 +406,7 @@ class DispatchSupervisor:
         from ..telemetry import tracing
 
         def once():
+            self._poll_host_plan(seam)
             fault = plan.poll(seam) if plan is not None else None
             return self._call_once(seam, fn, args, fault, plan)
 
@@ -370,6 +425,37 @@ class DispatchSupervisor:
 
         return retry_call(once, policy=self.retry_policy,
                           clock=self.clock, on_retry=on_retry)
+
+    def _poll_host_plan(self, seam) -> None:
+        """One host-fault-plan poll per dispatch attempt: does this
+        dispatch land on a host the adversary holds down?  The plan is
+        polled with the plane's CURRENT host count, so a fault whose
+        host the reshrink already evicted goes quiet — the redispatch
+        after a host quarantine completes like the survivors stopped
+        routing to the dead host (which is the point)."""
+        from ..chaos.hosts import (InjectedHostLoss,
+                                   InjectedHostPartition,
+                                   active_host_plan)
+        hplan = active_host_plan()
+        if hplan is None:
+            return
+        hosts = 1   # no plane: the process itself is one fault domain
+        if self._plane_ctl:
+            from ..parallel import plane as planemod
+            p = planemod.data_plane()
+            if p is not None:
+                hosts = p.hosts
+        fault = hplan.poll(seam, hosts)
+        if fault is None:
+            return
+        self._count("injected_faults")
+        if fault.kind == "host_partition":
+            raise InjectedHostPartition(
+                f"injected partition: host {fault.host} fenced at "
+                f"seam {seam!r} — its writes are stale and must be "
+                f"epoch-fenced")
+        raise InjectedHostLoss(
+            f"injected loss of host {fault.host} at seam {seam!r}")
 
     def _call_once(self, seam, fn, args, fault, plan):
         from ..chaos.dispatch import (DispatchHang,
@@ -449,17 +535,89 @@ class DispatchSupervisor:
 
     # -- escalation ------------------------------------------------------
 
-    def _escalate(self, seam, err, cur_fn, *, rebuild, host_fn):
-        """Persistent failure: quarantine a mesh member (when a plane
-        is active and the seam can rebuild) or demote the backend
-        tier.  Returns the next callable to try, or ``_HOST``."""
+    def _escalate(self, seam, err, cur_fn, *, rebuild, host_fn,
+                  cls=None):
+        """Persistent failure: quarantine a whole host fault domain
+        (``host_loss`` on a multi-host plane), else a mesh member
+        (when a plane is active and the seam can rebuild), else demote
+        the backend tier.  Returns the next callable to try, or
+        ``_HOST``."""
         if self._plane_ctl and rebuild is not None:
             from ..parallel import plane as planemod
             p = planemod.data_plane()
-            if p is not None and p.n_devices > 1:
-                return self._quarantine(seam, p, rebuild)
+            if p is not None:
+                if cls == "host_loss" and p.hosts > 1:
+                    return self._host_quarantine(seam, p, rebuild)
+                if p.n_devices > 1:
+                    return self._quarantine(seam, p, rebuild)
         return self._demote_tier(seam, err, cur_fn, rebuild=rebuild,
                                  host_fn=host_fn)
+
+    def _host_quarantine(self, seam, p, rebuild):
+        """Evict one host fault domain: halve the host count (every
+        device the lost domain contributed goes at once), replay the
+        lost host's journaled in-flight intents onto the survivor
+        plane, and rebuild the seam's program."""
+        from ..parallel import plane as planemod
+        from ..telemetry import metrics as tel
+        from ..telemetry import recorder, tracing
+        n_hosts, dph, n = p.hosts, p.devices_per_host, p.n_devices
+        if tracing.enabled():
+            tracing.annotate("supervisor_host_quarantine",
+                             self.clock.monotonic(), seam=seam,
+                             from_hosts=n_hosts,
+                             from_devices=n)
+        with self._lock:
+            if self._plane_width0 is None:
+                self._plane_width0 = n
+            if self._plane_hosts0 is None:
+                self._plane_hosts0 = n_hosts
+        nxt_h = n_hosts // 2
+        nxt = nxt_h * dph
+        self._count("host_quarantines")
+        tel.counter("supervisor_host_quarantines", seam=seam)
+        tel.event("supervisor_host_quarantine", seam=seam,
+                  from_hosts=n_hosts, to_hosts=max(nxt_h, 1),
+                  from_devices=n, to_devices=max(nxt, 1))
+        recorder.trip(
+            "host_quarantined",
+            f"host fault domain lost at {seam}: plane reshrink "
+            f"{n_hosts}x{dph} -> {max(nxt_h, 1)}x{dph} hosts",
+            seam=seam, from_hosts=n_hosts, to_hosts=max(nxt_h, 1),
+            from_devices=n, to_devices=max(nxt, 1))
+        plane_degraded(
+            f"host quarantine at {seam}: {n_hosts} -> "
+            f"{max(nxt_h, 1)} hosts", seam=seam,
+            from_devices=n, to_devices=max(nxt, 1))
+        dout("ec", 1, f"supervisor: quarantining host domain at "
+                      f"{seam}; plane {n_hosts}x{dph} -> "
+                      f"{max(nxt_h, 1)}x{dph}")
+        if nxt >= 2:
+            planemod.activate(nxt, hosts=nxt_h)
+        else:
+            planemod.deactivate()
+        self._cache_clear()
+        self._reclaim_inflight(seam)
+        return rebuild()
+
+    def _reclaim_inflight(self, seam) -> int:
+        """Run the registered journal reclaim hook (if any): replay
+        the lost host's intent records onto the shrunken plane.
+        Counted and flight-noted so the re-dispatch is attributable."""
+        with self._lock:
+            cb = self._inflight_reclaim
+        if cb is None:
+            return 0
+        n = int(cb(seam) or 0)
+        if n:
+            from ..telemetry import metrics as tel
+            from ..telemetry import recorder
+            self._count("journal_redispatches", n)
+            tel.counter("supervisor_journal_redispatches", seam=seam)
+            tel.event("supervisor_journal_redispatch", seam=seam,
+                      ops=n)
+            recorder.note("journal_redispatch", seam=seam, ops=n)
+        return n
 
     def _quarantine(self, seam, p, rebuild):
         from ..parallel import plane as planemod
@@ -473,6 +631,8 @@ class DispatchSupervisor:
         with self._lock:
             if self._plane_width0 is None:
                 self._plane_width0 = n
+            if self._plane_hosts0 is None and p.hosts > 1:
+                self._plane_hosts0 = p.hosts
         nxt = n // 2
         self._count("quarantines")
         tel.counter("supervisor_quarantines", seam=seam)
@@ -483,10 +643,18 @@ class DispatchSupervisor:
             f"mesh-member dispatch failure at {seam}: plane reshrink "
             f"{n} -> {max(nxt, 1)}",
             seam=seam, from_devices=n, to_devices=max(nxt, 1))
+        plane_degraded(
+            f"mesh-member quarantine at {seam}: {n} -> "
+            f"{max(nxt, 1)} devices", seam=seam,
+            from_devices=n, to_devices=max(nxt, 1))
         dout("ec", 1, f"supervisor: quarantining mesh member at "
                       f"{seam}; plane {n} -> {max(nxt, 1)}")
         if nxt >= 2:
-            planemod.activate(nxt)
+            # keep the host partition when it still divides the
+            # shrunken width; a non-dividing width collapses to one
+            # domain (the device ladder inside the survivor)
+            h = p.hosts if nxt % p.hosts == 0 else 1
+            planemod.activate(nxt, hosts=h)
         else:
             planemod.deactivate()
         self._cache_clear()
@@ -607,8 +775,15 @@ class DispatchSupervisor:
 
     def _probe_ok(self) -> bool:
         from ..chaos.dispatch import active_plan
+        from ..chaos.hosts import active_host_plan
         plan = active_plan()
         if plan is not None and plan.pending_persistent():
+            return False
+        hplan = active_host_plan()
+        if hplan is not None and hplan.pending_persistent():
+            # the adversary still holds a host down: a probe of the
+            # lost domain cannot answer, however healthy the shrunken
+            # plane looks — re-admission waits for the release
             return False
         if self._tier_demotions and self._policy_override is None:
             # re-probe the real backend identity without touching the
@@ -654,6 +829,7 @@ class DispatchSupervisor:
             n_demotions = self._tier_demotions
             self._tier_demotions = 0
             width0, self._plane_width0 = self._plane_width0, None
+            hosts0, self._plane_hosts0 = self._plane_hosts0, None
             self._floor = None
             self._clean_probes = 0
         restored = None
@@ -661,27 +837,62 @@ class DispatchSupervisor:
             restored = pol.promote()
         if width0 is not None and self._plane_ctl:
             from ..parallel import plane as planemod
-            planemod.activate(width0)
+            # the recovered host re-joins: full width AND the original
+            # host partition come back together
+            planemod.activate(width0, hosts=hosts0 or 1)
         self._cache_clear()
         from ..telemetry import tracing
         if tracing.enabled():
             tracing.annotate("supervisor_repromote",
                              self.clock.monotonic(),
                              tier=restored or "",
-                             plane_width=width0 or 0)
+                             plane_width=width0 or 0,
+                             plane_hosts=hosts0 or 0)
         self._count("repromotions")
         tel.counter("supervisor_repromotions")
+        if hosts0 and hosts0 > 1:
+            self._count("host_repromotions")
+            tel.counter("supervisor_host_repromotions")
         tel.event("supervisor_repromote", tier=restored,
-                  plane_width=width0)
+                  plane_width=width0, plane_hosts=hosts0)
         recorder.trip(
             "repromoted",
             f"health probe clean x{self.promote_after}: tier restored "
             f"to {restored or 'probed'}"
             + (f", plane restored to {width0} devices"
-               if width0 else ""),
-            tier=restored or "", plane_width=width0 or 0)
+               if width0 else "")
+            + (f" across {hosts0} hosts" if hosts0 else ""),
+            tier=restored or "", plane_width=width0 or 0,
+            plane_hosts=hosts0 or 0)
         dout("ec", 1, f"supervisor: re-promoted (tier={restored}, "
-                      f"plane={width0})")
+                      f"plane={width0}, hosts={hosts0})")
+
+
+# ----------------------------------------------------------------------
+# shared degrade bookkeeping (ISSUE 17 satellite): ONE emission shape
+# for every path that narrows the data plane — activation-time degrade
+# (parallel/plane.py::_degrade), mid-run device quarantine and host
+# quarantine all land here, so dashboards and the flight ring see the
+# same counter/event/note regardless of WHEN the plane narrowed.
+
+def plane_degraded(reason: str, *, seam: str = "parallel.plane",
+                   from_devices: Optional[int] = None,
+                   to_devices: int = 1) -> None:
+    """Record one plane-narrowing event: ``engine_mesh_degraded``
+    counter + structured event + flight-ring note.
+
+    Deliberately module-level and LOCK-FREE on the supervisor side
+    (telemetry locks only, ranks 300+): ``parallel.plane`` calls this
+    while holding ``parallel.plane._lock`` (rank 240), and routing
+    through the rank-120 ``global_supervisor()`` singleton lock there
+    would invert the declared lock order (analysis/lockmodel.py)."""
+    from ..telemetry import metrics as tel
+    from ..telemetry import recorder
+    tel.counter("engine_mesh_degraded")
+    tel.event("engine_mesh_degraded", reason=reason, seam=seam,
+              from_devices=from_devices, to_devices=to_devices)
+    recorder.note("engine_mesh_degraded", reason=reason, seam=seam,
+                  from_devices=from_devices, to_devices=to_devices)
 
 
 # ----------------------------------------------------------------------
@@ -790,5 +1001,6 @@ def supervisor_selftest() -> dict:
 
 
 __all__ = ["DispatchSupervisor", "classify_dispatch_error",
-           "global_supervisor", "set_global_supervisor", "supervised",
+           "global_supervisor", "plane_degraded",
+           "set_global_supervisor", "supervised",
            "supervisor_selftest"]

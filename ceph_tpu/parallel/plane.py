@@ -26,6 +26,27 @@ Degrade policy (mirrors ops/fallback.py): a plane that cannot form
 - ``auto`` / ``on``        — activate over every visible device at
   first use;
 - ``<N>``                  — activate over the first N devices.
+
+Host fault domains (ISSUE 17): the plane additionally carries a
+``hosts`` partition — ``n_devices = hosts * devices_per_host`` — so
+the supervisor can quarantine a whole host (every device it
+contributes) in one reshrink step.  ``CEPH_TPU_HOSTS``:
+
+- unset / ``0`` / ``off`` / ``1`` — single fault domain (today);
+- ``auto`` / ``on``               — one domain per jax process
+  (``jax.process_count()``: the real ``jax.distributed`` fleet);
+- ``<H>``                         — H simulated fault domains carved
+  out of the visible devices (the CI mode under
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+
+A host count that does not divide the device count clamps the plane
+down to ``hosts * (n_devices // hosts)`` devices — fault domains are
+equal-width by construction, mirroring a real fleet's homogeneous
+hosts.  Real multi-process fleets bootstrap via
+:func:`init_distributed` (``CEPH_TPU_DIST_COORD`` /
+``CEPH_TPU_DIST_PROCS`` / ``CEPH_TPU_DIST_ID`` →
+``jax.distributed.initialize``), which CI never needs: the simulated
+mode exercises the same reshrink/re-promotion ladder in-process.
 """
 
 from __future__ import annotations
@@ -50,21 +71,35 @@ class DataPlane:
     any mesh whose first axis is the batch axis works.
     """
 
-    def __init__(self, mesh, axis: str = DEFAULT_AXIS) -> None:
+    def __init__(self, mesh, axis: str = DEFAULT_AXIS,
+                 hosts: int = 1) -> None:
         if axis not in mesh.axis_names:
             raise ValueError(f"mesh has no axis {axis!r} "
                              f"(axes: {mesh.axis_names})")
+        n = int(mesh.shape[axis])
+        if hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {hosts}")
+        if n % hosts:
+            raise ValueError(f"hosts={hosts} does not divide the "
+                             f"{n}-device {axis!r} axis: fault domains "
+                             f"must be equal-width")
         self.mesh = mesh
         self.axis = axis
+        self.hosts = hosts
 
     @property
     def n_devices(self) -> int:
         """Devices on the sharded axis (= devices doing stripe work)."""
         return int(self.mesh.shape[self.axis])
 
+    @property
+    def devices_per_host(self) -> int:
+        """Sharded-axis devices each host fault domain contributes."""
+        return self.n_devices // self.hosts
+
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return (f"DataPlane(axis={self.axis!r}, "
-                f"shape={dict(self.mesh.shape)})")
+                f"shape={dict(self.mesh.shape)}, hosts={self.hosts})")
 
 
 _lock = make_lock("parallel.plane._lock")
@@ -107,11 +142,36 @@ def tuned_fanout() -> Optional[int]:
     return None
 
 
-def _build_plane(n_devices: Optional[int]) -> Optional[DataPlane]:
-    """A tp=1 (pure-dp) plane over the first n devices, or None when a
-    mesh cannot form — the degrade-to-single-device path, logged and
-    counted, never silent.  An auto plane (``n_devices=None``)
-    consults the tuned fan-out width first."""
+def _resolve_hosts(n: int, hosts: Optional[int]) -> int:
+    """The plane's host-domain count: an explicit ``hosts`` argument
+    wins; otherwise ``CEPH_TPU_HOSTS`` (see module docstring).  Always
+    clamped into ``[1, n]``."""
+    if hosts is None:
+        env = os.environ.get("CEPH_TPU_HOSTS", "").strip().lower()
+        if env in ("", "0", "off", "no", "none", "1"):
+            hosts = 1
+        elif env in ("auto", "on"):
+            try:
+                import jax
+                hosts = int(jax.process_count())
+            except (RuntimeError, ImportError):
+                hosts = 1
+        else:
+            try:
+                hosts = int(env)
+            except ValueError:
+                _degrade(f"unparseable CEPH_TPU_HOSTS={env!r}")
+                hosts = 1
+    return max(1, min(int(hosts), n))
+
+
+def _build_plane(n_devices: Optional[int],
+                 hosts: Optional[int] = None) -> Optional[DataPlane]:
+    """A tp=1 (pure-dp) plane over the first n devices partitioned
+    into ``hosts`` equal fault domains, or None when a mesh cannot
+    form — the degrade-to-single-device path, logged and counted,
+    never silent.  An auto plane (``n_devices=None``) consults the
+    tuned fan-out width first."""
     if n_devices is None:
         n_devices = tuned_fanout()
     try:
@@ -126,18 +186,31 @@ def _build_plane(n_devices: Optional[int]) -> Optional[DataPlane]:
         _degrade(f"no usable backend ({type(e).__name__}: {e})")
         return None
     n = avail if n_devices is None else min(n_devices, avail)
+    h = _resolve_hosts(max(n, 1), hosts)
+    if n % h:
+        # equal-width fault domains: clamp the plane down to the
+        # largest host-divisible width (never silently reshape h)
+        n = h * (n // h)
     if n < 2:
         _degrade(f"{n} device(s) visible; mesh tier needs >= 2")
         return None
     from .mesh import make_mesh
-    return DataPlane(make_mesh(n, tp=1))
+    return DataPlane(make_mesh(n, tp=1), hosts=h)
 
 
 def _degrade(reason: str) -> None:
+    """Degrade to the single-device tier — through the supervisor's
+    shared quarantine bookkeeping (ops/supervisor.py::plane_degraded),
+    so activation-time degradation and a mid-run reshrink emit the
+    SAME ``engine_mesh_degraded`` counter/event/flight-note shape.
+    The helper is module-level and lock-free on the supervisor side
+    (telemetry locks only, ranks 300+): we are called with
+    ``parallel.plane._lock`` (rank 240) held, and routing through the
+    rank-120 supervisor singleton lock here would invert the declared
+    order."""
     dout("ec", 1, f"data plane degraded to single-device: {reason}")
-    from ..telemetry import metrics as tel
-    tel.counter("engine_mesh_degraded")
-    tel.event("engine_mesh_degraded", reason=reason)
+    from ..ops.supervisor import plane_degraded
+    plane_degraded(reason, seam="parallel.plane.activate")
 
 
 def data_plane() -> Optional[DataPlane]:
@@ -163,12 +236,14 @@ def data_plane() -> Optional[DataPlane]:
         return _active
 
 
-def activate(n_devices: Optional[int] = None) -> Optional[DataPlane]:
-    """Activate a plane over (the first n of) the visible devices.
-    Returns the plane, or None when one cannot form (degrade policy
-    above); the previous plane, if any, is replaced."""
+def activate(n_devices: Optional[int] = None,
+             hosts: Optional[int] = None) -> Optional[DataPlane]:
+    """Activate a plane over (the first n of) the visible devices,
+    partitioned into ``hosts`` fault domains (None = CEPH_TPU_HOSTS
+    resolution).  Returns the plane, or None when one cannot form
+    (degrade policy above); the previous plane, if any, is replaced."""
     global _active, _env_resolved
-    plane = _build_plane(n_devices)
+    plane = _build_plane(n_devices, hosts)
     with _lock:
         _env_resolved = True
         _active = plane
@@ -213,13 +288,14 @@ def resolve_plane(mesh) -> Optional[DataPlane]:
 
 
 @contextmanager
-def mesh_plane(n_devices: Optional[int] = None):
+def mesh_plane(n_devices: Optional[int] = None,
+               hosts: Optional[int] = None):
     """Activate a plane for the duration of a block (bench workloads,
     tests); restores whatever was active before, including "nothing"."""
     global _active, _env_resolved
     with _lock:
         prev, prev_resolved = _active, _env_resolved
-    plane = activate(n_devices)
+    plane = activate(n_devices, hosts)
     try:
         yield plane
     finally:
@@ -243,3 +319,39 @@ def plane_topology(plane: Optional[DataPlane] = None) -> Optional[list]:
     if plane is None:
         return None
     return [int(plane.mesh.shape[a]) for a in plane.mesh.axis_names]
+
+
+def host_plane_topology(
+        plane: Optional[DataPlane] = None) -> Optional[dict]:
+    """The active plane's host partition for reports/bench metadata:
+    ``{"hosts": H, "devices_per_host": D}``, or None (no plane)."""
+    if plane is None:
+        plane = data_plane()
+    if plane is None:
+        return None
+    return {"hosts": int(plane.hosts),
+            "devices_per_host": int(plane.devices_per_host)}
+
+
+def init_distributed() -> bool:
+    """Bootstrap the real multi-process fleet, env-gated so CI (the
+    simulated mode) never depends on it: when ``CEPH_TPU_DIST_COORD``,
+    ``CEPH_TPU_DIST_PROCS`` and ``CEPH_TPU_DIST_ID`` are all set,
+    calls ``jax.distributed.initialize(coord, procs, id)`` once and
+    returns True.  Unset (or already initialized): returns False and
+    touches nothing."""
+    coord = os.environ.get("CEPH_TPU_DIST_COORD", "").strip()
+    procs = os.environ.get("CEPH_TPU_DIST_PROCS", "").strip()
+    pid = os.environ.get("CEPH_TPU_DIST_ID", "").strip()
+    if not (coord and procs and pid):
+        return False
+    import jax
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(procs),
+                                   process_id=int(pid))
+    except RuntimeError as e:
+        # double-init (framework already bootstrapped) is benign
+        dout("ec", 1, f"jax.distributed.initialize skipped: {e}")
+        return False
+    return True

@@ -196,6 +196,53 @@ class IntentJournal:
             self.cleared += 1
         return stats
 
+    def reclaim(self, stores, *, fence_epoch: Optional[int] = None
+                ) -> Tuple[ReplayStats, List[IntentRecord]]:
+        """Host-loss in-flight reclaim (ISSUE 17): the survivors'
+        answer to "what was the lost host in the middle of?".
+
+        Same verify/keep/roll-back discipline as :meth:`replay` — the
+        journal cannot tell a crash from a host loss, and does not
+        need to — but the rolled-back records are RETURNED (snapshot
+        taken before the record clears) so the host-quarantine path
+        can re-dispatch exactly those ops on the shrunken plane.  The
+        re-dispatch must ``begin()`` fresh intents at a **bumped
+        epoch**: anything the lost (or partitioned — it may still be
+        writing) host lands under the old epoch then fails the epoch
+        fence exactly like a stale recovery op does today.
+
+        ``fence_epoch``: only records with ``epoch < fence_epoch`` are
+        reclaimed (None = all pending) — ops begun after the loss was
+        detected belong to the survivors and stay pending."""
+        from ..telemetry import metrics as tel
+        stats = ReplayStats()
+        redo: List[IntentRecord] = []
+        for op_id in sorted(self.records):
+            rec = self.records[op_id]
+            if fence_epoch is not None and rec.epoch >= fence_epoch:
+                continue
+            store = stores[rec.obj]
+            matched = {int(s): self._shard_matches(store, s, w)
+                       for s, w in rec.payloads.items()}
+            stats.shards_kept += sum(matched.values())
+            torn = [s for s, ok in matched.items()
+                    if not ok and s in store.shards]
+            for shard in torn:
+                store.delete(shard)
+                stats.shards_deleted += 1
+            stats.replayed += 1
+            if all(matched.values()):
+                stats.completed += 1
+            else:
+                stats.rolled_back += 1
+                redo.append(rec)
+            del self.records[op_id]
+            self.cleared += 1
+        tel.counter("journal_reclaims")
+        tel.event("journal_reclaim", ops=stats.replayed,
+                  redispatch=len(redo), fence_epoch=fence_epoch)
+        return stats, redo
+
 
 __all__ = ["CRC_SEED", "IntentJournal", "IntentRecord", "IntentState",
            "ReplayStats", "payload_digest"]

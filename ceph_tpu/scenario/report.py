@@ -47,6 +47,12 @@ class ScenarioReport:
     # re-promotions) + the chaos plan summary when the ScenarioSpec
     # armed device-plane faults; None when the spec armed none
     supervisor: Optional[dict] = None
+    # host fault domains (ISSUE 17, chaos/hosts.py + the host-aware
+    # plane): the armed host-fault plan summary, the host-granular
+    # supervisor counter delta (host_quarantines/host_repromotions/
+    # journal_redispatches) and the plane topology before/after; None
+    # when the spec armed no host-plane chaos
+    host_plane: Optional[dict] = None
 
     # -- convenience accessors (the contention axes) ---------------------
 
@@ -91,6 +97,8 @@ class ScenarioReport:
             out["profile"] = self.profile
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor
+        if self.host_plane is not None:
+            out["host_plane"] = self.host_plane
         return out
 
     def to_json(self) -> str:

@@ -462,16 +462,25 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
     # re-promotes mid-run
     from ..chaos.dispatch import DispatchFault, DispatchFaultPlan, \
         arm_plan
+    from ..chaos.hosts import HostFault, HostFaultPlan, arm_host_plan
     from ..ops.supervisor import global_supervisor
     dplan = None
     prev_plan = None
+    hplan = None
+    prev_hplan = None
+    prev_reclaim = None
+    host_plane_activated = False
+    prev_plane = None
+    topo_armed = None
+    topo_end = None
     sup = None
     sup_before: dict = {}
-    if chaos.dispatch_fault:
+    if chaos.dispatch_fault or chaos.host_loss:
         sup = global_supervisor()
         sup.reset_pacing()
         sup_before = {k: v for k, v in sup.stats().items()
                       if isinstance(v, int)}
+    if chaos.dispatch_fault:
         dplan = DispatchFaultPlan(
             [DispatchFault(chaos.dispatch_fault,
                            seam=chaos.dispatch_fault_seam,
@@ -479,17 +488,49 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
                            calls=chaos.dispatch_fault_calls)],
             seed=spec.seed + 404)
         prev_plan = arm_plan(dplan)
+    if chaos.host_loss:
+        # host fault domains (ISSUE 17, chaos/hosts.py): arm the
+        # seeded host fault; on a device executor, span a simulated
+        # multi-host plane so the loss is survivable host-granular
+        # (the host executor exercises the planeless ladder: loss of
+        # host 0 demotes straight to the ground-truth twin)
+        hplan = HostFaultPlan(
+            [HostFault(chaos.host_loss, host=chaos.host_loss_host,
+                       seam=chaos.host_loss_seam,
+                       at=chaos.host_loss_at,
+                       calls=chaos.host_loss_calls)],
+            seed=spec.seed + 505)
+        prev_hplan = arm_host_plan(hplan)
+        if executor != "host":
+            from ..parallel import plane as planemod
+            prev_plane = planemod.data_plane()
+            planemod.activate(None,
+                              hosts=max(2, chaos.host_loss_hosts))
+            host_plane_activated = True
+            topo_armed = planemod.host_plane_topology()
 
     # -- QoS arbiter + throttle (the closed loop) ------------------------
     arbiter = MClockArbiter(spec.qos, clock=clock,
                             enabled=enable_arbiter)
     throttle = OsdRecoveryThrottle(max_inflight=4)
     throttle.set_osd_weights(osd_weights)
+    journal = IntentJournal()
     orch = RecoveryOrchestrator(
         sinfo, ec, m, EC_POOL, spec.recovery_ps, stores, hinfos,
-        journal=IntentJournal(), throttle=throttle, clock=clock,
+        journal=journal, throttle=throttle, clock=clock,
         device=(False if executor == "host" else None),
         max_rounds=spec.max_recovery_rounds)
+    if hplan is not None:
+        # in-flight survival: when the supervisor quarantines a host it
+        # calls back here — the survivors reclaim the lost host's
+        # journaled intents (verify/keep/roll back); rolled-back ops
+        # re-enter the orchestrator's next planning round on the
+        # shrunken plane at a bumped epoch (journal.reclaim docstring)
+        def _reclaim_lost_host(seam: str) -> int:
+            _stats, redo = journal.reclaim(stores)
+            return len(redo)
+
+        prev_reclaim = sup.set_inflight_reclaim(_reclaim_lost_host)
     churn = MapChurn(seed=spec.seed + 202, max_down=chaos.max_down,
                      fire_every=1, max_events=chaos.storm_events)
     placements_before = _sample_placements(m)
@@ -576,6 +617,11 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
             # the health probe then re-promotes within promote_after
             # clean ticks
             dplan.clear()
+        if hplan is not None:
+            # the lost host "comes back" (or is replaced) once the
+            # stream drains: the plan goes quiet and the health probe
+            # re-promotes the plane to full host width
+            hplan.clear()
         while (not state["converged"]
                and orch.report.rounds < spec.max_recovery_rounds):
             if sup is not None:
@@ -590,10 +636,19 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
             # a no-op once nothing is demoted)
             for _ in range(sup.promote_after + 1):
                 sup.tick()
+        if host_plane_activated:
+            from ..parallel.plane import host_plane_topology
+            topo_end = host_plane_topology()
         elapsed = clock.monotonic() - t_start
     finally:
         if dplan is not None:
             arm_plan(prev_plan)
+        if hplan is not None:
+            arm_host_plan(prev_hplan)
+            sup.set_inflight_reclaim(prev_reclaim)
+        if host_plane_activated:
+            from ..parallel import plane as planemod
+            planemod.set_data_plane(prev_plane)
 
     # -- gates + report --------------------------------------------------
     rec = orch.report
@@ -641,7 +696,7 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
         from ..telemetry.profiler import global_profiler
         profile = global_profiler().attribution_rows()
     supervisor_section = None
-    if sup is not None:
+    if dplan is not None:
         after = sup.stats()
         delta = {k: after[k] - sup_before.get(k, 0)
                  for k in sup_before if isinstance(after.get(k), int)}
@@ -654,6 +709,29 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
             "plan": dplan.summary(),
             "demoted_at_end": after["demoted"],
             "tier_floor_at_end": after["tier_floor"],
+        }
+    host_plane_section = None
+    if hplan is not None:
+        after = sup.stats()
+        delta = {k: after[k] - sup_before.get(k, 0)
+                 for k in sup_before if isinstance(after.get(k), int)}
+        host_keys = ("host_quarantines", "host_repromotions",
+                     "journal_redispatches", "quarantines",
+                     "repromotions", "demotions", "promotions",
+                     "injected_faults", "dispatch_errors")
+        host_plane_section = {
+            "fault": {"kind": chaos.host_loss,
+                      "host": chaos.host_loss_host,
+                      "hosts": chaos.host_loss_hosts,
+                      "seam": chaos.host_loss_seam,
+                      "at": chaos.host_loss_at,
+                      "calls": chaos.host_loss_calls},
+            "counters": {k: delta[k] for k in host_keys
+                         if delta.get(k)},
+            "plan": hplan.summary(),
+            "topology_armed": topo_armed,
+            "topology_at_end": topo_end,
+            "demoted_at_end": after["demoted"],
         }
     report = ScenarioReport(
         name=spec.name, seed=spec.seed, executor=executor,
@@ -674,6 +752,7 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
         },
         profile=profile,
         supervisor=supervisor_section,
+        host_plane=host_plane_section,
     )
     tel.gauge("scenario_deadline_miss_rate",
               report.slo.get("deadline_miss_rate") or 0.0)

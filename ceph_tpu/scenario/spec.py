@@ -78,6 +78,20 @@ class ChaosSchedule:
     dispatch_fault_seam: str = "engine.fused_repair"
     dispatch_fault_at: int = 2
     dispatch_fault_calls: Optional[int] = 4
+    # host fault domains (ISSUE 17, chaos/hosts.py): lose a whole host
+    # mid-stream.  ``host_loss`` arms one seeded HostFault
+    # (host_loss|host_flap|host_partition) against ``host_loss_host``
+    # at ``host_loss_seam``'s ``host_loss_at``-th call, active for
+    # ``host_loss_calls`` calls (None = until the runner heals the
+    # plan after the stream drains).  The runner activates a simulated
+    # ``host_loss_hosts``-domain plane for the run when armed.  None =
+    # no host-plane chaos (every pre-ISSUE-17 scenario JSON).
+    host_loss: Optional[str] = None
+    host_loss_host: int = 1
+    host_loss_hosts: int = 2
+    host_loss_seam: str = "engine.fused_repair"
+    host_loss_at: int = 2
+    host_loss_calls: Optional[int] = 4
 
     def to_dict(self) -> dict:
         return asdict(self)

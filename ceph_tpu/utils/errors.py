@@ -56,6 +56,39 @@ class RetryExhausted(CephTpuError):
         self.deadline_expired = deadline_expired
 
 
+class ProbeTimeout(CephTpuError):
+    """A health/host probe burned its whole time budget without an
+    answer — the probed endpoint is WEDGED, not flaky.
+
+    Terminal by design: probe callers (utils/retry.py::probe_call)
+    raise this instead of RetryExhausted so the supervisor classifies
+    it as the hang class (``backend_loss``) and escalates the ladder
+    — a slow probe must never fall into the ``transient`` retry loop
+    against an endpoint that will not answer.  Carries ``.elapsed``
+    and ``.deadline_expired`` like RetryExhausted (and ``.deadline``,
+    the budget that ran out), so probe reports stay structurally
+    interchangeable with retry reports.
+    """
+
+    def __init__(self, target: str, deadline: float,
+                 elapsed: Optional[float] = None,
+                 deadline_expired: bool = True,
+                 last: Optional[BaseException] = None) -> None:
+        msg = f"probe of {target!r} exceeded deadline {deadline}s"
+        if elapsed is not None:
+            msg += f" in {elapsed:.3f}s"
+        if last is not None:
+            msg += f": {type(last).__name__}: {last}"
+        super().__init__(msg)
+        self.target = target
+        self.deadline = deadline
+        self.elapsed = elapsed
+        self.deadline_expired = deadline_expired
+        self.last = last
+        if last is not None:
+            self.__cause__ = last
+
+
 class InjectedCrash(CephTpuError):
     """A deterministic crash raised at a named crash site
     (chaos.CrashPoint) — the process-died stand-in the recovery

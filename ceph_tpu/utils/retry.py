@@ -27,12 +27,13 @@ the chaos/scrub suites); production uses the module default
 
 from __future__ import annotations
 
+import dataclasses
 import random
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple, Type
 
-from .errors import RetryExhausted, TransientBackendError
+from .errors import ProbeTimeout, RetryExhausted, TransientBackendError
 
 
 class SystemClock:
@@ -181,3 +182,48 @@ def retry_call(fn: Callable, *args,
         tel.counter("retry_deadline_expired")
     raise RetryExhausted(attempts_made, last, elapsed=elapsed,
                          deadline_expired=deadline_expired) from last
+
+
+def probe_call(fn: Callable, *args,
+               target: str = "backend",
+               deadline: float = 1.0,
+               policy: Optional[RetryPolicy] = None,
+               clock=None,
+               **kwargs):
+    """Run a health/host probe under a HARD time budget.
+
+    Same retry semantics as :func:`retry_call`, but the terminal error
+    is :class:`ProbeTimeout`, never RetryExhausted — the supervisor
+    classifies ProbeTimeout as the hang class (``backend_loss``), so a
+    wedged endpoint escalates the ladder instead of transient-looping.
+    Two ways to time out:
+
+    - the retry schedule exhausts (attempts or deadline) — the
+      RetryExhausted is swallowed and re-raised as ProbeTimeout with
+      its ``.elapsed``/``.deadline_expired``/``.last`` carried over;
+    - the probe *answers*, but only after ``deadline`` elapsed — a
+      probe that slow IS a wedged endpoint (there is no way to
+      interrupt a stuck call, so the overrun is detected post-hoc,
+      exactly like the supervisor's slow-dispatch detection).
+    """
+    from ..telemetry import metrics as tel
+    clock = clock or SystemClock()
+    if policy is None:
+        policy = RetryPolicy(attempts=2, deadline=deadline)
+    elif policy.deadline is None:
+        policy = dataclasses.replace(policy, deadline=deadline)
+    start = clock.monotonic()
+    try:
+        out = retry_call(fn, *args, policy=policy, clock=clock,
+                         **kwargs)
+    except RetryExhausted as e:
+        tel.counter("probe_timeouts", target=target)
+        raise ProbeTimeout(target, deadline, elapsed=e.elapsed,
+                           deadline_expired=e.deadline_expired,
+                           last=e.last) from e.last
+    elapsed = clock.monotonic() - start
+    if elapsed > deadline:
+        tel.counter("probe_timeouts", target=target)
+        raise ProbeTimeout(target, deadline, elapsed=elapsed,
+                           deadline_expired=True)
+    return out

@@ -261,11 +261,14 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
+    # metric_version 14 (ISSUE 17): every line carries the host-chaos
+    # rows (recovery under a whole lost host fault domain —
+    # tests/test_host_plane.py pins the bench_diff category)
+    assert bench.METRIC_VERSION == 14
     # metric_version 13 (ISSUE 16): the audit-meta blob stamps
     # whether the instrumented-lock runtime validator was live
     # (CEPH_TPU_LOCKCHECK=1) — lockcheck rows never compare against
     # production rows
-    assert bench.METRIC_VERSION == 13
     assert "lockcheck" in bench._audit_meta()
     # metric_version 12 (ISSUE 15): the serving and scenario rows
     # carry the `tail_attribution` blob (per-segment share of p99
@@ -290,8 +293,14 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
                         lambda host_only=False, requests=None: {})
     monkeypatch.setattr(bench, "_device_chaos_rows",
                         lambda host_only=False: {})
+    monkeypatch.setattr(bench, "_host_chaos_rows",
+                        lambda host_only=False: {})
     err = bench._error_line("tunnel down", 2.6, "recorded", 0.1)
     assert err["metric_version"] == bench.METRIC_VERSION
+    # metric_version 14: the host-chaos rows ride the error line too
+    # (a tunnel-down round still reports what the host plane did)
+    assert "host_chaos_rows" in err
+    assert dict(bench.HOST_CHAOS_ROWS)  # at least one declared row
     # metric_version 11: the autotune rows ride the error line too
     # (host-only analytic sweep — the tunnel-down tuning path)
     assert "autotune_rows" in err

@@ -80,6 +80,12 @@ FLOORS: Dict[str, float] = {
     # rows; a silent cliff (e.g. the supervisor thrashing the
     # pattern cache) must still trip the sentinel
     "device_chaos": 0.55,
+    # recovery-under-host-loss (ISSUE 17): a whole simulated host
+    # fault domain drops mid-run — the GB/s includes the host-granular
+    # reshrink, the journal-reclaim hook and the re-promotion rebuild,
+    # so it shares device_chaos's wide floor; a silent survival-path
+    # cliff must still trip the sentinel
+    "host_chaos": 0.55,
     "profile": 0.60,
     # the autotune rows track the tuner's best after-utilization-%:
     # modeled (analytic) rows are deterministic, timed rows swing
@@ -114,6 +120,7 @@ def extract_series(rec: dict) -> Dict[str, float]:
                          ("degraded_rows", "degraded"),
                          ("multichip_rows", "multichip"),
                          ("device_chaos_rows", "device_chaos"),
+                         ("host_chaos_rows", "host_chaos"),
                          ("profile_rows", "profile")):
         body = rec.get(section)
         if not isinstance(body, dict):

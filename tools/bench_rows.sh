@@ -140,6 +140,12 @@ run_row "row 12: device-chaos — batched recovery through the supervised fused-
     -s $((1<<16)) --workload device-chaos --batch 8 --iterations 2 \
     -e 1 --json
 
+run_row "row 12b: host-chaos — batched recovery while a seeded HostLoss takes a whole simulated host fault domain out mid-run (ISSUE 17; host-granular reshrink, journal-reclaim hook, re-promotion to full host width in the supervisor counters, metric_version 14)" \
+    python -m ceph_tpu.bench.erasure_code_benchmark \
+    -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \
+    -s $((1<<19)) --workload host-chaos --batch 8 --iterations 2 \
+    --hosts 2 -e 1 --json
+
 run_row "row 13: autotune — profiler-driven config sweep over the bounded declarative space (ISSUE 14; timed min-of-N candidate dispatches, byte-identity asserted per tier, before/after utilization rows + the persisted best-config table, metric_version 11)" \
     python -m ceph_tpu.bench.erasure_code_benchmark \
     -p jerasure -P technique=reed_sol_van -P k=8 -P m=3 \

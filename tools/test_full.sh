@@ -148,6 +148,28 @@ python tools/device_chaos_demo.py --corrupt >/dev/null \
     || { echo "device_chaos_demo: supervised dispatch gate failed"; exit 1; }
 python tools/device_chaos_demo.py --erasures 4 >/dev/null 2>&1
 [ $? -eq 2 ] || { echo "device_chaos_demo: expected unrecoverable rc 2"; exit 1; }
+# Host-fault-domain gates (ISSUE 17 / docs/ROBUSTNESS.md "Host fault
+# domains"): a seeded production day on a simulated 2-host plane that
+# loses a WHOLE host domain mid-stream must complete with a
+# byte-identical heal vs the unfailed control, one host-granular
+# reshrink (2x4 -> 1x4, host_quarantined flight dump), the lost
+# host's in-flight intents re-dispatched, and a re-promotion back to
+# full host width once the adversary releases (rc 0); a past-budget
+# damage mix must still exit with the structured unrecoverable report
+# (rc 2); and the REAL-process version must hold: two worker
+# processes, one SIGKILLed mid-batch, the survivor detecting the loss
+# by heartbeat ProbeTimeout and finishing byte-identical on the
+# shrunken plane (no re-promotion while the peer stays dead).
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python tools/host_chaos_demo.py >/dev/null \
+    || { echo "host_chaos_demo: host fault-domain gate failed"; exit 1; }
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python tools/host_chaos_demo.py --erasures 4 >/dev/null 2>&1
+[ $? -eq 2 ] || { echo "host_chaos_demo: expected unrecoverable rc 2"; exit 1; }
+python tools/host_chaos_demo.py --kill-one >/dev/null \
+    || { echo "host_chaos_demo: multi-process kill-one gate failed"; exit 1; }
 # Simulated-mesh gate (ISSUE 8 / docs/PERF.md "Multi-chip data
 # plane"): the sharded engine tier must hold on an 8-way virtual CPU
 # mesh — trace audit of the sharded entry points (shard_map program
@@ -158,6 +180,7 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python tools/tpu_lint.py --trace \
     --entry engine.fused_repair_sharded \
+    --entry engine.fused_repair_host_sharded \
     --entry serve.dispatch_sharded \
     --entry ops.apply_matrix_best_sharded \
     --entry crush.bulk_rule_sharded \
