@@ -164,7 +164,17 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # tunnel-down error path the same loop runs host-only (no plane: the
 # process is its one fault domain, so the loss demotes to the
 # ground-truth twin — the width-1 ladder).
-METRIC_VERSION = 14
+# v15 (ISSUE 18, paged ragged serving): serving rows gain a paged
+# twin (`serving_mixed_paged`) — the HBM-resident paged stripe pool +
+# ragged kernel family (serve/pool.py, --paged): mixed stripe sizes
+# co-batch into ONE device program per (plugin, op) pattern, so the
+# row carries `paged`, `cached_programs` (the bucket×rung collapse
+# witness) and `page_pool` (live occupancy + lifetime alloc/reclaim
+# accounting; used_pages must drain to 0).  padding_overhead on the
+# paged row is byte-based (page-tail bytes only) and is the
+# bench_diff `serving_padding` category.  All of it rides the
+# host-only error line too — the pool is host bookkeeping.
+METRIC_VERSION = 15
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -255,6 +265,14 @@ SERVING_ROWS = [
      ["--workload", "serving", "--device", "jax",
       "--size", str(1 << 16), "--requests", "256",
       "--concurrency", "64", "--seed", "42"]),
+    # v15: the paged twin — same stream through the paged stripe pool
+    # + ragged kernel family (no shape buckets; one program per
+    # (plugin, op) pattern at any occupancy/chunk size).  Its
+    # padding_overhead is the `serving_padding` bench_diff category.
+    ("serving_mixed_paged",
+     ["--workload", "serving", "--device", "jax",
+      "--size", str(1 << 16), "--requests", "256",
+      "--concurrency", "64", "--seed", "42", "--paged"]),
 ]
 
 
@@ -566,7 +584,8 @@ def _serving_rows(host_only: bool = False, requests: int | None = None
             row = _row_result(res)
             for f in ("gbps_under_slo", "deadline_miss_rate",
                       "padding_overhead", "requests", "rejected",
-                      "stream_compiles", "tail_attribution"):
+                      "stream_compiles", "tail_attribution",
+                      "paged", "cached_programs", "page_pool"):
                 row[f] = res.get(f)
             rows[name] = row
         except Exception as e:  # noqa: BLE001 - recorded, never fatal

@@ -510,3 +510,230 @@ def serve_dispatch_call(ec, op: str, available: Tuple[int, ...] = (),
         return timed
 
     return global_pattern_cache().get_or_build(key, build)
+
+
+# -- ragged paged serving dispatch (ISSUE 18) ---------------------------
+
+def _shard_program_ragged(raw, plane, n_out: int):
+    """Mesh variant of a ragged (pool, mask) body: the PAGE axis is
+    the sharded axis (pages are independent mini-chunks, so they fan
+    out like stripes), the mask sharded alongside, matrices
+    replicated.  Non-dividing pools zero-pad pages with a ZERO mask —
+    dead by construction, so the pad computes zeros and is sliced
+    off."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.plane import single_device
+    from ..utils.shard import batch_spec, shard_map_compat
+
+    ndev = plane.n_devices
+    spec3 = batch_spec(plane.axis, 3)
+    spec1 = batch_spec(plane.axis, 1)
+
+    def body(local_pool, local_mask):
+        with single_device():
+            return raw(local_pool, local_mask)
+
+    sharded = shard_map_compat(
+        body, plane.mesh, in_specs=(spec3, spec1),
+        out_specs=tuple([spec3] * n_out) if n_out > 1 else spec3)
+
+    @jax.jit
+    def fn(pool, mask):
+        p = pool.shape[0]
+        pad = (-p) % ndev
+        if pad:
+            pool = jnp.pad(pool, ((0, pad), (0, 0), (0, 0)))
+            mask = jnp.pad(mask, ((0, pad),))
+        out = sharded(pool, mask)
+        if not pad:
+            return out
+        if n_out == 1:
+            return out[:p]
+        return tuple(o[:p] for o in out)
+
+    return fn
+
+
+def _ragged_surface(ec, op: str):
+    """The plugin's true ragged surface when it has one (matrix /
+    bitmatrix / clay composite families), else None — the generic
+    mask-gate body runs instead, byte-identically."""
+    return getattr(type(ec), f"{op}_chunks_ragged_jax", None)
+
+
+def serve_dispatch_ragged(ec, op: str, available: Tuple[int, ...] = (),
+                          erased: Tuple[int, ...] = (), *,
+                          pages: int, page_size: int, mesh=None):
+    """ONE cached, jitted ragged program per (plugin, profile, op,
+    erasure pattern, pool geometry): the paged batcher's device seam
+    (serve/pool.py stages the pool; serve/batcher.py fires it here).
+
+    The program signature is ``(pool, mask)`` — pool
+    ``(pages, rows, page_size)`` uint8, mask ``(pages,)`` {0,1} — and
+    the mask is a TRACED operand: every occupancy of the pool runs
+    the SAME compiled program, so the cached-program count for a
+    serving day is |patterns|, not |buckets| x |ladder| (the dense
+    ladder's per-rung programs).  Dead pages compute zeros in every
+    tier (GF linearity), so demux never reads them.
+
+    - ``encode``: pool pages are (k, page_size) mini-chunks -> parity
+      pages (pages, m, page_size)
+    - ``decode``: survivor pages -> (pages, n_erased, page_size)
+    - ``repair``: the fused decode -> column-assembly -> re-encode of
+      fused_repair_call, on the masked page batch -> (rec, parity)
+
+    On TPU backends the pool operand is DONATED: steady-state serving
+    re-uses the previous fire's HBM pages instead of allocating per
+    dispatch (CPU/GPU skip donation — XLA:CPU would warn and copy).
+    With an active data plane the program shards the PAGE axis
+    (pages are independent mini-chunks) under a mesh-suffixed key in
+    the same PatternCache keyspace."""
+    import jax
+
+    if op not in ("encode", "decode", "repair"):
+        raise ValueError(f"serve op {op!r} must be encode|decode|repair")
+    available = tuple(available)
+    erased = tuple(erased)
+    plane = _resolve_mesh(mesh)
+    extra = ("paged", int(pages), int(page_size))
+    if plane is not None:
+        extra += ("mesh", plane.n_devices)
+    key = pattern_key(ec, f"serve-{op}-ragged", available, erased,
+                      extra)
+
+    def build():
+        import jax.numpy as jnp
+
+        from ..ops.pallas_gf import mask_pages
+
+        if op == "repair":
+            from .stripe import _chunk_mapping
+            mapping = _chunk_mapping(ec)
+            k = ec.get_data_chunk_count()
+            aidx = {s: t for t, s in enumerate(available)}
+            eidx = {s: t for t, s in enumerate(erased)}
+            src = []
+            for c in range(k):
+                shard = mapping[c]
+                if shard in aidx:
+                    src.append(("avail", aidx[shard]))
+                elif shard in eidx:
+                    src.append(("rec", eidx[shard]))
+                else:
+                    raise IOError(
+                        f"data shard {shard} neither available nor "
+                        f"erased in pattern (avail={available}, "
+                        f"erased={erased})")
+
+        dec = _ragged_surface(ec, "decode")
+        enc = _ragged_surface(ec, "encode")
+
+        def raw(pool, mask):
+            if op == "encode":
+                if enc is not None:
+                    return enc(ec, pool, mask)
+                return ec.encode_chunks_jax(mask_pages(pool, mask))
+            if op == "decode":
+                if dec is not None:
+                    return dec(ec, pool, mask, available, erased)
+                return ec.decode_chunks_jax(mask_pages(pool, mask),
+                                            available, erased)
+            # repair: the fused_repair_call body on the page batch —
+            # survivors mask-gated ONCE so the column assembly and
+            # the re-encode see zeros on dead pages
+            x = mask_pages(pool, mask)
+            with jax.named_scope("serve_ragged.decode"):
+                if dec is not None:
+                    rec = dec(ec, pool, mask, available, erased)
+                else:
+                    rec = ec.decode_chunks_jax(x, available, erased)
+            cols = [x[:, t, :] if where == "avail" else rec[:, t, :]
+                    for where, t in src]
+            data = jnp.stack(cols, axis=1)
+            with jax.named_scope("serve_ragged.reencode"):
+                parity = ec.encode_chunks_jax(data)
+            return rec, parity
+
+        n_out = 2 if op == "repair" else 1
+        if plane is not None:
+            fn = _shard_program_ragged(raw, plane, n_out=n_out)
+        elif jax.default_backend() == "tpu":
+            # donate the pool's HBM buffer forward (see docstring);
+            # the mask is tiny and NOT donated (the batcher re-reads
+            # it for demux bookkeeping)
+            fn = jax.jit(raw, donate_argnums=(0,))
+        else:
+            fn = jax.jit(raw)
+
+        # supervised-dispatch couplings (ops/supervisor.py): numpy
+        # ground truth = zero the dead pages, then the same batch
+        # surfaces the dense host twin runs (byte-identical pinned in
+        # tests/test_serve.py); rebuild re-derives the program after
+        # a tier demotion / plane reshrink
+        def host_twin(pool, mask):
+            import numpy as np
+            x = np.asarray(pool) * (np.asarray(mask) != 0).astype(
+                np.uint8)[:, None, None]
+            if op == "encode":
+                return np.asarray(ec.encode_chunks_batch(x))
+            if op == "decode":
+                return np.asarray(ec.decode_chunks_batch(
+                    x, available, erased))
+            from ..serve.batcher import _host_repair
+            return _host_repair(ec, x, available, erased)
+
+        def rebuild():
+            return serve_dispatch_ragged(
+                ec, op, available, erased, pages=pages,
+                page_size=page_size, mesh=mesh)._raw
+
+        ndev = plane.n_devices if plane is not None else 1
+        from ..tune.table import active_source
+        prof_key = ("prof",) + key
+        prof_labels = dict(
+            plugin=type(ec).__name__, kind=f"serve-{op}-ragged",
+            profile=",".join(f"{pk}={pv}" for pk, pv in
+                             sorted(ec.get_profile().items())),
+            pattern="e" + "_".join(map(str, erased)),
+            engine="mesh" if plane is not None else "device",
+            devices=ndev, config=active_source()[0])
+
+        def timed(pool, mask):
+            # same trace-eagerness discipline as serve_dispatch_call
+            eager = not (isinstance(pool, jax.core.Tracer)
+                         or isinstance(mask, jax.core.Tracer))
+            prof = _profiler()
+            if eager and tel.enabled():
+                if plane is not None:
+                    tel.counter("engine_mesh_dispatches",
+                                tier=f"serve-{op}-ragged",
+                                devices=str(plane.n_devices))
+                # ONE program per pattern: the profiler key carries
+                # the (static) pool page count, not a rung
+                pk = prof_key + (int(pool.shape[0]),)
+                prof.capture(pk, fn, (pool, mask),
+                             name="engine.serve_dispatch_ragged",
+                             batch=int(pool.shape[0]), **prof_labels)
+            else:
+                pk = prof_key
+            if eager and trc.enabled():
+                trc.note_program(
+                    "engine.serve_dispatch_ragged",
+                    dict(prof_labels, batch=int(pool.shape[0])))
+            with tel.record_dispatch(
+                    "serve_dispatch_ragged", eager=eager,
+                    op=op, plugin=type(ec).__name__), \
+                    prof.timed(pk, eager=eager):
+                if not eager:
+                    return fn(pool, mask)
+                from ..ops.supervisor import global_supervisor
+                return global_supervisor().dispatch(
+                    f"engine.serve-{op}-ragged", fn, (pool, mask),
+                    host_fn=host_twin, rebuild=rebuild)
+
+        timed._raw = fn
+        return timed
+
+    return global_pattern_cache().get_or_build(key, build)

@@ -25,6 +25,18 @@ it, the Ragged-Paged-Attention discipline (PAPERS.md, arxiv
   batches fill; under trickle traffic nobody waits past their
   deadline for co-batchees that never come.
 
+**Paged mode** (``paged=True``) replaces the shape buckets with
+per-(plugin, profile, op, pattern) queues over a bounded
+:class:`~ceph_tpu.serve.pool.PagedStripePool`: mixed stripe sizes
+co-batch into ONE ragged device program per queue
+(codes/engine.py :: serve_dispatch_ragged — the per-fire activity mask
+is a traced operand), the only padding is page-tail bytes, pool
+exhaustion is the backpressure signal (fire + retry) and pages are
+reclaimed explicitly at demux.  Deadline-slack firing, demux
+byte-identity and the warm==0 contract are unchanged; the cached-
+program count collapses from |buckets| x |ladder| to |patterns|
+(``cached_program_count()`` witnesses it).
+
 Execution goes through :func:`~ceph_tpu.codes.engine.serve_dispatch_call`
 (``executor="device"``; repair reuses the scrub path's fused
 decode→re-encode program and cache entry) or the plugins' numpy batch
@@ -47,6 +59,8 @@ from ..telemetry import metrics as tel
 from ..telemetry import span
 from ..telemetry import tracing
 from ..utils.log import dout
+from .pool import (PagedStripePool, PoolExhausted, effective_page_size,
+                   tuned_pool_config)
 from .queue import AdmissionQueue, EcRequest, EcResult
 
 # padded stripe-batch sizes: every dispatch shape's batch dim is one
@@ -84,12 +98,20 @@ _EWMA_ALPHA = 0.3
 _MIN_SLACK = 1e-3
 
 
-def rung_for(n: int, ladder: Tuple[int, ...]) -> int:
-    """Smallest ladder rung holding ``n`` requests."""
+def rung_for(n: int, ladder: Tuple[int, ...],
+             strict: bool = False) -> int:
+    """Smallest ladder rung holding ``n`` requests.  Occupancy above
+    the top rung maps to the TOP rung — the batcher splits oversized
+    admissions into top-rung batches instead of erroring (each slice
+    its own warmed program, so the zero-recompile contract holds).
+    ``strict=True`` restores the legacy erroring contract for callers
+    that sized their admission path to the ladder."""
     for r in ladder:
         if n <= r:
             return r
-    raise ValueError(f"occupancy {n} exceeds top rung {ladder[-1]}")
+    if strict:
+        raise ValueError(f"occupancy {n} exceeds top rung {ladder[-1]}")
+    return ladder[-1]
 
 
 class _Bucket:
@@ -109,6 +131,40 @@ class _Bucket:
         self.chunk_size = chunk_size
         self.rows = rows
         self.requests: List[EcRequest] = []
+
+    @property
+    def oldest_deadline(self) -> float:
+        return min(r.deadline for r in self.requests)
+
+
+class _RaggedQueue:
+    """One paged queue: same plugin/profile/op/pattern — same RAGGED
+    device program, ANY chunk size (the shape-bucket collapse of
+    ISSUE 18).  Owns the bounded page pool; ``chunk_size`` is the PAGE
+    size and a firing "rung" is the live page count, so the
+    ``(bucket, rung) -> seconds`` service-model contract carries over
+    bytes-exact (rung * rows * chunk_size == live_pages * rows *
+    page_size)."""
+
+    __slots__ = ("key", "ec", "op", "available", "erased", "rows",
+                 "page_size", "pool", "requests")
+
+    def __init__(self, key, ec, op, available, erased, rows,
+                 page_size, pool_pages) -> None:
+        self.key = key
+        self.ec = ec
+        self.op = op
+        self.available = available
+        self.erased = erased
+        self.rows = rows
+        self.page_size = page_size
+        self.pool = PagedStripePool(pool_pages, rows, page_size,
+                                    ec.page_interleave())
+        self.requests: List[EcRequest] = []
+
+    @property
+    def chunk_size(self) -> int:
+        return self.page_size
 
     @property
     def oldest_deadline(self) -> float:
@@ -135,7 +191,10 @@ class ContinuousBatcher:
                  ladder: Optional[Tuple[int, ...]] = None,
                  executor: str = "device",
                  service_model: Optional[Callable] = None,
-                 min_slack: float = _MIN_SLACK) -> None:
+                 min_slack: float = _MIN_SLACK,
+                 paged: bool = False,
+                 page_size: Optional[int] = None,
+                 pool_pages: Optional[int] = None) -> None:
         from ..utils.retry import SystemClock
 
         if ladder is None:
@@ -154,8 +213,26 @@ class ContinuousBatcher:
         self.executor = executor
         self.service_model = service_model
         self.min_slack = min_slack
+        self.paged = bool(paged)
+        if self.paged:
+            cfg_ps, cfg_pp = tuned_pool_config()
+            self.page_size = (int(page_size) if page_size is not None
+                              else cfg_ps)
+            self.pool_pages = (int(pool_pages) if pool_pages is not None
+                               else cfg_pp)
+            if self.page_size < 1 or self.pool_pages < 1:
+                raise ValueError(
+                    f"page_size {self.page_size} / pool_pages "
+                    f"{self.pool_pages} must be positive")
+        else:
+            self.page_size = page_size
+            self.pool_pages = pool_pages
         self._instances: Dict[tuple, object] = {}
         self._buckets: "Dict[tuple, _Bucket]" = {}
+        self._queues: "Dict[tuple, _RaggedQueue]" = {}
+        # distinct programs this stream exercised: dense (key, rung)
+        # pairs vs one key per paged queue — the collapse witness
+        self._programs: set = set()
         self._est: Dict[tuple, float] = {}
         # per-dispatch composition log (bucket key, rung, req ids) —
         # the byte-identical-replay witness tests and the demo print
@@ -164,6 +241,10 @@ class ContinuousBatcher:
         self.stripes = 0
         self.padded_stripes = 0
         self.padded_bytes = 0
+        # paged-mode byte accounting: the only padding is page-tail
+        # bytes, so overhead is byte-based, not stripe-based
+        self.paged_tail_bytes = 0
+        self.paged_data_bytes = 0
         self.warmup_dispatches = 0
 
     # -- plugin instance + bucket resolution ----------------------------
@@ -207,12 +288,37 @@ class ContinuousBatcher:
                 key, ec, req.op, req.available, req.erased, chunk, rows)
         return b
 
+    def ragged_key(self, req: EcRequest) -> tuple:
+        """The paged-queue identity — the PatternCache key WITHOUT the
+        chunk-size extra: mixed stripe sizes co-batch into one queue,
+        one pool, ONE ragged device program."""
+        from ..codes.engine import pattern_key
+
+        ec = self._instance(req.plugin, req.profile)
+        return pattern_key(ec, f"serve-{req.op}", req.available,
+                           req.erased)
+
+    def _queue_for(self, req: EcRequest) -> _RaggedQueue:
+        key = self.ragged_key(req)
+        q = self._queues.get(key)
+        if q is None:
+            ec = self._instance(req.plugin, req.profile)
+            rows = (ec.get_data_chunk_count() if req.op == "encode"
+                    else len(req.available))
+            ps = effective_page_size(self.page_size, ec.page_unit())
+            q = self._queues[key] = _RaggedQueue(
+                key, ec, req.op, req.available, req.erased, rows, ps,
+                self.pool_pages)
+        return q
+
     # -- admission -------------------------------------------------------
 
     def admit(self, requests: List[EcRequest]) -> List[EcResult]:
         """Classify requests into buckets; a bucket reaching the top
         rung fires immediately (continuous batching — full buckets
         never wait for the next poll)."""
+        if self.paged:
+            return self._admit_paged(requests)
         results: List[EcResult] = []
         for req in requests:
             b = self._bucket_for(req)
@@ -234,6 +340,38 @@ class ContinuousBatcher:
                 results += self._fire(b)
         return results
 
+    def _admit_paged(self, requests: List[EcRequest]) -> List[EcResult]:
+        """Paged admission: stage each request's pages into its
+        queue's pool.  A full pool is the backpressure signal — fire
+        the queue NOW (demux reclaims every page), then retry the
+        write; a pool with no free page left after a write fires too
+        (continuous batching).  A single request no empty pool could
+        hold raises ValueError (size the pool, don't wedge it)."""
+        results: List[EcResult] = []
+        for req in requests:
+            q = self._queue_for(req)
+            chunk = q.ec.get_chunk_size(req.stripe_size)
+            want = (q.rows, chunk)
+            if tuple(req.payload.shape) != want:
+                raise ValueError(
+                    f"request {req.req_id}: payload shape "
+                    f"{tuple(req.payload.shape)} != {want} for "
+                    f"op={req.op} plugin={req.plugin}")
+            try:
+                q.pool.write(req.req_id, req.payload)
+            except PoolExhausted:
+                results += self._fire_ragged(q)
+                q.pool.write(req.req_id, req.payload)
+            q.requests.append(req)
+            if req.trace is not None:
+                req.trace.add("bucket", self.clock.monotonic(),
+                              bucket="|".join(str(p) for p in q.key),
+                              pending=len(q.requests),
+                              pages=q.pool.used_pages())
+            if q.pool.free_pages() == 0:
+                results += self._fire_ragged(q)
+        return results
+
     # -- deadline-aware firing ------------------------------------------
 
     def est_service(self, key: tuple) -> float:
@@ -250,10 +388,22 @@ class ContinuousBatcher:
         completion ~one service time early instead."""
         return 2.0 * self.est_service(key) + self.min_slack
 
-    def _due(self, b: _Bucket, now: float) -> bool:
+    def _due(self, b, now: float) -> bool:
         if not b.requests:
             return False
         return b.oldest_deadline - now - self._margin(b.key) <= 0.0
+
+    def _units(self):
+        """Every fireable unit — dense buckets and paged queues (both
+        carry key / requests / oldest_deadline, so the deadline-slack
+        policy is mode-blind)."""
+        yield from self._buckets.values()
+        yield from self._queues.values()
+
+    def _fire_unit(self, b) -> List[EcResult]:
+        if isinstance(b, _RaggedQueue):
+            return self._fire_ragged(b)
+        return self._fire(b)
 
     def poll(self, queue: Optional[AdmissionQueue] = None
              ) -> List[EcResult]:
@@ -265,31 +415,29 @@ class ContinuousBatcher:
         if queue is not None:
             results += self.admit(queue.drain())
         now = self.clock.monotonic()
-        due = sorted((b for b in self._buckets.values()
-                      if self._due(b, now)),
+        due = sorted((b for b in self._units() if self._due(b, now)),
                      key=lambda b: b.oldest_deadline)
         for b in due:
-            results += self._fire(b)
+            results += self._fire_unit(b)
         return results
 
     def flush(self) -> List[EcResult]:
-        """Fire every non-empty bucket (end of stream)."""
+        """Fire every non-empty bucket/queue (end of stream)."""
         results: List[EcResult] = []
-        for b in sorted((b for b in self._buckets.values()
-                         if b.requests),
+        for b in sorted((b for b in self._units() if b.requests),
                         key=lambda b: b.oldest_deadline):
-            results += self._fire(b)
+            results += self._fire_unit(b)
         return results
 
     def next_wakeup(self) -> Optional[float]:
         """Earliest absolute time any bucket becomes due (the sim
         driver advances its FakeClock here when idle)."""
         times = [b.oldest_deadline - self._margin(b.key)
-                 for b in self._buckets.values() if b.requests]
+                 for b in self._units() if b.requests]
         return min(times) if times else None
 
     def pending(self) -> int:
-        return sum(len(b.requests) for b in self._buckets.values())
+        return sum(len(b.requests) for b in self._units())
 
     # -- dispatch --------------------------------------------------------
 
@@ -297,6 +445,7 @@ class ContinuousBatcher:
         """One batched execution: the jitted serve program (device) or
         the numpy batch surfaces (host).  Returns op-shaped host
         arrays (device outputs fetched once per batch)."""
+        self._programs.add((b.key, stack.shape[0]))
         if self.executor == "device":
             from ..codes.engine import serve_dispatch_call
 
@@ -322,7 +471,20 @@ class ContinuousBatcher:
         return _host_repair(b.ec, stack, b.available, b.erased)
 
     def _fire(self, b: _Bucket) -> List[EcResult]:
+        """Fire a bucket; occupancy above the top rung (an oversized
+        admission burst) is split into top-rung slices — every slice
+        rides an already-warmed program, so the legacy hard error is
+        gone without any new shape."""
         reqs, b.requests = b.requests, []
+        results: List[EcResult] = []
+        top = self.ladder[-1]
+        while reqs:
+            take, reqs = reqs[:top], reqs[top:]
+            results += self._fire_slice(b, take)
+        return results
+
+    def _fire_slice(self, b: _Bucket,
+                    reqs: List[EcRequest]) -> List[EcResult]:
         n = len(reqs)
         rung = rung_for(n, self.ladder)
         stack = np.zeros((rung, b.rows, b.chunk_size), np.uint8)
@@ -397,6 +559,127 @@ class ContinuousBatcher:
                        deadline_met=res.deadline_met)
         return results
 
+    # -- ragged dispatch -------------------------------------------------
+
+    def _execute_ragged(self, q: _RaggedQueue, mask: np.ndarray):
+        """One ragged execution over the queue's WHOLE pool: the
+        mask-gated jitted program (device,
+        engine.serve_dispatch_ragged) or the identical masked numpy
+        batch surfaces (host).  Either way the program consumes
+        ``(pages, rows, page_size) + (pages,)`` with the mask as a
+        traced operand — ONE cached program per queue at any
+        occupancy."""
+        self._programs.add(q.key)
+        if self.executor == "device":
+            from ..codes.engine import serve_dispatch_ragged
+
+            call = serve_dispatch_ragged(
+                q.ec, q.op, q.available, q.erased,
+                pages=q.pool.pages, page_size=q.page_size)
+            out = call(q.pool.buf, mask)
+            if q.op == "repair":
+                rec, parity = out
+                return np.asarray(rec), np.asarray(parity)
+            return np.asarray(out)
+        if tracing.enabled():
+            tracing.note_program(
+                "serve.host", {"op": q.op, "paged": True,
+                               "plugin": type(q.ec).__name__})
+        # the host tier runs the IDENTICAL ragged program: mask-gate
+        # the pool (dead pages carry stale bytes), then the batch
+        # surfaces over pages-as-mini-chunks
+        x = q.pool.buf * (mask != 0).astype(np.uint8)[:, None, None]
+        if q.op == "encode":
+            return np.asarray(q.ec.encode_chunks_batch(x))
+        if q.op == "decode":
+            return np.asarray(q.ec.decode_chunks_batch(
+                x, q.available, q.erased))
+        return _host_repair(q.ec, x, q.available, q.erased)
+
+    def _fire_ragged(self, q: _RaggedQueue) -> List[EcResult]:
+        reqs, q.requests = q.requests, []
+        if not reqs:
+            return []
+        n = len(reqs)
+        mask = q.pool.mask()
+        live = int(mask.sum())
+        traced = (tracing.enabled()
+                  and any(r.trace is not None for r in reqs))
+        if traced:
+            tracing.clear_program()
+        t0 = self.clock.monotonic()
+        with span("serve.batch", op=q.op, occupancy=n, rung=live,
+                  plugin=type(q.ec).__name__, paged=True):
+            with span("serve.dispatch", executor=self.executor):
+                out = self._execute_ragged(q, mask)
+            if self.service_model is not None:
+                # sim mode: the rung is the live page count, so the
+                # modeled bytes (live * rows * page_size) are EXACT —
+                # no padded-rung bytes in the model either
+                self.clock.sleep(self.service_model(q, live))
+        t1 = self.clock.monotonic()
+        service = t1 - t0
+        self._est[q.key] = (service if q.key not in self._est else
+                            (1 - _EWMA_ALPHA) * self._est[q.key]
+                            + _EWMA_ALPHA * service)
+        self.dispatches += 1
+        self.stripes += n
+        # the ONLY padding in the paged path: per-request page-tail
+        # bytes (zero whenever page_size divides the chunk size)
+        tail_cols = sum(q.pool.tail_bytes(r.req_id) for r in reqs)
+        self.padded_bytes += tail_cols * q.rows
+        self.paged_tail_bytes += tail_cols * q.rows
+        self.paged_data_bytes += sum(
+            r.payload.shape[1] * q.rows for r in reqs)
+        tel.counter("serve_dispatches", op=q.op)
+        tel.counter("serve_stripes", n, op=q.op)
+        if tail_cols:
+            tel.counter("serve_page_tail_bytes", tail_cols * q.rows,
+                        op=q.op)
+        tel.observe("serve_batch_occupancy", n, op=q.op)
+        tel.observe("serve_pool_live_pages", live, op=q.op)
+        self.dispatch_log.append({
+            "bucket": "|".join(str(p) for p in q.key),
+            "op": q.op, "occupancy": n, "rung": live,
+            "req_ids": [r.req_id for r in reqs], "paged": True})
+        results = []
+        for r in reqs:
+            if q.op == "repair":
+                rec, parity = out
+                payload_out = (q.pool.read(r.req_id, rec),
+                               q.pool.read(r.req_id, parity))
+            else:
+                payload_out = q.pool.read(r.req_id, out)
+            wait = t0 - (r.arrival if r.arrival is not None else t0)
+            tel.observe("serve_queue_wait_seconds", max(0.0, wait),
+                        op=q.op)
+            results.append(EcResult(
+                request=r, output=payload_out, completed=t1,
+                queue_wait=max(0.0, wait), service=service,
+                batch_occupancy=n, batch_rung=live,
+                deadline_met=(r.deadline is None or t1 <= r.deadline)))
+            # explicit page reclaim at demux — the pool is empty again
+            # the moment every rider has its bytes back
+            q.pool.reclaim(r.req_id)
+        if traced:
+            program = tracing.take_program()
+            batch_seq = self.dispatches - 1
+            t_done = self.clock.monotonic()
+            for r, res in zip(reqs, results):
+                tr = r.trace
+                if tr is None:
+                    continue
+                tr.add("fire", t0, occupancy=n, rung=live,
+                       batch_seq=batch_seq, executor=self.executor,
+                       paged=True,
+                       co_batched=[x.req_id for x in reqs])
+                if program is not None:
+                    tr.add("program", t0, series=program)
+                tr.add("dispatch_end", t1)
+                tr.add("done", t_done,
+                       deadline_met=res.deadline_met)
+        return results
+
     # -- warmup ----------------------------------------------------------
 
     def warmup(self, requests: List[EcRequest]) -> int:
@@ -405,7 +688,13 @@ class ContinuousBatcher:
         (bucket, rung).  After this, a stream drawn from the same mix
         compiles NOTHING — the armed recompile budget and the compile
         monitor both stay flat (the acceptance gate's 'zero warm
-        recompiles').  Returns the number of warmup dispatches."""
+        recompiles').  Returns the number of warmup dispatches.
+
+        Paged mode warms ONE program per queue instead of |ladder| per
+        bucket — the activity mask is a traced operand, so a single
+        compile covers every occupancy."""
+        if self.paged:
+            return self._warmup_paged(requests)
         seen = set()
         fired = 0
         for req in requests:
@@ -442,9 +731,80 @@ class ContinuousBatcher:
                  f"rungs ({fired} dispatches)")
         return fired
 
+    def _warmup_paged(self, requests: List[EcRequest]) -> int:
+        """One zero-mask dispatch per distinct queue pays the compile;
+        a second (full-mask, zero pool) dispatch times steady-state
+        service for the deadline-slack estimator (the sim model is the
+        estimator in sim mode, as on the dense path)."""
+        seen = set()
+        fired = 0
+        for req in requests:
+            key = self.ragged_key(req)
+            if key in seen:
+                continue
+            seen.add(key)
+            q = self._queue_for(req)
+            self._execute_ragged(q, np.zeros(q.pool.pages, np.uint8))
+            fired += 1
+            if self.service_model is not None:
+                self._est[key] = self.service_model(q, q.pool.pages)
+            else:
+                full = np.ones(q.pool.pages, np.uint8)
+                t0 = self.clock.monotonic()
+                self._execute_ragged(q, full)
+                self._est[key] = self.clock.monotonic() - t0
+                fired += 1
+        self.warmup_dispatches += fired
+        if fired:
+            tel.counter("serve_warmup_dispatches", fired)
+            dout("serve", 10,
+                 f"warmed {len(seen)} paged queues ({fired} "
+                 f"dispatches, one program each)")
+        return fired
+
     # -- accounting ------------------------------------------------------
 
+    def cached_program_count(self) -> int:
+        """Distinct programs this batcher's stream exercised: dense =
+        (bucket, rung) pairs (every rung is its own XLA program);
+        paged = one per queue (the mask is traced, so every occupancy
+        AND every chunk size shares one compile) — the program-count
+        collapse the paged path exists for."""
+        return len(self._programs)
+
+    def pool_stats(self) -> dict:
+        """Aggregate page-pool accounting across the paged queues
+        (live occupancy feeds the bench serving rows)."""
+        qs = list(self._queues.values())
+        return {
+            "queues": len(qs),
+            "pages": sum(q.pool.pages for q in qs),
+            "used_pages": sum(q.pool.used_pages() for q in qs),
+            "high_water": sum(q.pool.high_water for q in qs),
+            "allocs": sum(q.pool.allocs for q in qs),
+            "reclaims": sum(q.pool.reclaims for q in qs),
+            "backpressure": sum(q.pool.backpressure for q in qs),
+        }
+
     def padding_stats(self) -> dict:
+        if self.paged:
+            total = self.paged_data_bytes + self.paged_tail_bytes
+            return {
+                "dispatches": self.dispatches,
+                "stripes": self.stripes,
+                # paged mode never pads whole stripes; overhead is the
+                # byte-based page-tail ratio (0.0 when the page size
+                # divides every chunk size in the mix)
+                "padded_stripes": 0,
+                "padded_bytes": self.padded_bytes,
+                "padding_overhead": (
+                    round(self.paged_tail_bytes / total, 6)
+                    if total else 0.0),
+                "warmup_dispatches": self.warmup_dispatches,
+                "paged": True,
+                "cached_programs": self.cached_program_count(),
+                "pool": self.pool_stats(),
+            }
         total = self.stripes + self.padded_stripes
         return {
             "dispatches": self.dispatches,
@@ -454,6 +814,8 @@ class ContinuousBatcher:
             "padding_overhead": (round(self.padded_stripes / total, 6)
                                  if total else 0.0),
             "warmup_dispatches": self.warmup_dispatches,
+            "paged": False,
+            "cached_programs": self.cached_program_count(),
         }
 
 

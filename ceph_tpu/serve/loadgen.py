@@ -80,6 +80,12 @@ class TrafficSpec:
     ladder: Tuple[int, ...] = LADDER
     queue_capacity: int = 4096
     pool: int = 8                    # distinct stripes per codec
+    # paged serving (ISSUE 18): ragged queues over a page pool instead
+    # of shape buckets over the rung ladder; None = tuned/default pool
+    # geometry (serve/pool.py::tuned_pool_config)
+    paged: bool = False
+    page_size: Optional[int] = None
+    pool_pages: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.arrival not in ("closed", "open"):
@@ -99,6 +105,8 @@ class TrafficSpec:
             "concurrency": self.concurrency, "erasures": self.erasures,
             "ladder": list(self.ladder),
             "queue_capacity": self.queue_capacity, "pool": self.pool,
+            "paged": self.paged, "page_size": self.page_size,
+            "pool_pages": self.pool_pages,
         }
 
     @classmethod
@@ -110,7 +118,10 @@ class TrafficSpec:
             arrival=d["arrival"], rate=d["rate"],
             concurrency=d["concurrency"], erasures=d["erasures"],
             ladder=tuple(d["ladder"]),
-            queue_capacity=d["queue_capacity"], pool=d["pool"])
+            queue_capacity=d["queue_capacity"], pool=d["pool"],
+            paged=bool(d.get("paged", False)),
+            page_size=d.get("page_size"),
+            pool_pages=d.get("pool_pages"))
 
 
 def default_spec(seed: int = 42, n_requests: int = 256,

@@ -261,10 +261,13 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
-    # metric_version 14 (ISSUE 17): every line carries the host-chaos
-    # rows (recovery under a whole lost host fault domain —
-    # tests/test_host_plane.py pins the bench_diff category)
-    assert bench.METRIC_VERSION == 14
+    # metric_version 15 (ISSUE 18): the serving section carries the
+    # paged twin (serving_mixed_paged) with paged/cached_programs/
+    # page_pool — tests/test_serve.py pins the bench_diff
+    # serving_padding category
+    assert bench.METRIC_VERSION == 15
+    assert "serving_mixed_paged" in dict(bench.SERVING_ROWS)
+    assert "--paged" in dict(bench.SERVING_ROWS)["serving_mixed_paged"]
     # metric_version 13 (ISSUE 16): the audit-meta blob stamps
     # whether the instrumented-lock runtime validator was live
     # (CEPH_TPU_LOCKCHECK=1) — lockcheck rows never compare against

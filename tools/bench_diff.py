@@ -22,9 +22,14 @@ makes it a gate:
    ``device_chaos:<row>`` (recovery-under-fault GB/s through the
    supervised dispatch plane — ISSUE 13), ``profile:<row>``,
    ``autotune:<row>`` (the tuner's best after-utilization-% — a tuned
-   config that later regresses fails CI, ISSUE 14).
-   Ratios/latency rows are deliberately excluded — one sentinel, one
-   direction (utilization-% is higher-is-better like GB/s).
+   config that later regresses fails CI, ISSUE 14),
+   ``serving_padding:<row>`` (the ONE lower-is-better series:
+   serving padding_overhead — the paged stripe pool of ISSUE 18
+   holds it near zero, and a silent reinflation toward dense-bucket
+   padding must trip the sentinel; judged inverted, with an absolute
+   near-zero slack).
+   Other ratios/latency rows are deliberately excluded — one
+   sentinel, one direction per category.
 3. **Diff with per-row noise floors** — the CURRENT record (BENCH_
    LAST_GOOD.json, or ``--candidate <file>`` for a fresh bench line)
    regresses a row when it falls below the best prior value by more
@@ -93,7 +98,23 @@ FLOORS: Dict[str, float] = {
     # tuned config silently regressing to the default's utilization
     # must still trip the sentinel (ISSUE 14)
     "autotune": 0.50,
+    # serving padding_overhead (ISSUE 18): the one LOWER-is-better
+    # category — the fraction of dispatched bytes that were padding.
+    # The paged rows sit near zero (page tails only), so the ratio is
+    # taken with an absolute slack (PADDING_EPS) and a wide relative
+    # floor: a paged row silently reinflating toward dense-bucket
+    # padding must trip the sentinel, seeded-mix jitter must not
+    "serving_padding": 0.50,
 }
+
+# categories where SMALLER current values are better: best prior is
+# the minimum, and a regression is current ABOVE best * (1 + floor)
+LOWER_IS_BETTER = frozenset({"serving_padding"})
+
+# absolute slack for near-zero lower-is-better ratios: 0.01 is the
+# paged acceptance bound (padding_overhead < 0.01 under the pinned
+# mixed-size contention test), so movement inside it never trips
+PADDING_EPS = 0.01
 
 
 def _gbps(value) -> Optional[float]:
@@ -164,6 +185,13 @@ def extract_series(rec: dict) -> Dict[str, float]:
                 g = _gbps(row)
             if g is not None and g > 0:
                 series[f"{cat}:{name}"] = float(g)
+            if cat == "serving":
+                # the lower-is-better padding series (ISSUE 18): zero
+                # is a real, meaningful value here, so >= 0 not > 0
+                p = row.get("padding_overhead")
+                if isinstance(p, (int, float)) \
+                        and not isinstance(p, bool) and p >= 0:
+                    series[f"serving_padding:{name}"] = float(p)
     return series
 
 
@@ -236,8 +264,9 @@ def diff(trajectory: List[Tuple[str, dict]], current_label: str,
         if _record_id(rec) == cur_id:
             continue  # the current record riding in the trajectory
         for name, v in extract_series(rec).items():
+            lower = name.split(":", 1)[0] in LOWER_IS_BETTER
             best = prior.get(name)
-            if best is None or v > best[0]:
+            if best is None or (v < best[0] if lower else v > best[0]):
                 prior[name] = (v, label)
     cur_series = extract_series(current)
     rows, regressions, improvements = [], [], []
@@ -258,6 +287,17 @@ def diff(trajectory: List[Tuple[str, dict]], current_label: str,
             # measurement is how a cliff hides), not of the kernel
             row["status"] = "missing"
             regressions.append(row)
+        elif cat in LOWER_IS_BETTER:
+            # inverted sense, with absolute slack: near-zero padding
+            # values would make a bare ratio explode on noise
+            ratio = (cur + PADDING_EPS) / (best[0] + PADDING_EPS)
+            row["ratio"] = round(ratio, 4)
+            if ratio > 1.0 + floor:
+                row["status"] = "regression"
+                regressions.append(row)
+            elif ratio < 1.0 - floor:
+                row["status"] = "improvement"
+                improvements.append(row)
         else:
             ratio = cur / best[0]
             row["ratio"] = round(ratio, 4)
