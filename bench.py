@@ -174,7 +174,18 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # paged row is byte-based (page-tail bytes only) and is the
 # bench_diff `serving_padding` category.  All of it rides the
 # host-only error line too — the pool is host bookkeeping.
-METRIC_VERSION = 15
+# v16 (ISSUE 19, multi-tenant week): a `tenant_week_rows` section —
+# the 3-tenant compressed week (--workload tenant-week;
+# ceph_tpu/scenario/week.py): per-tenant diurnal streams under the
+# per-tenant mClock door, discrete-event fast-forward, staged
+# correlated disasters (rack/backend/host loss + burst storm) healing
+# byte-identically.  The row carries per-tenant scorecards, the
+# isolation-gate verdict against per-tenant isolated baselines, and
+# `victim_gbps_under_slo` — the victims' GB/s-under-SLO with the
+# burst storm raging (the bench_diff `tenant_isolation` series).
+# The whole week is a deterministic EventClock simulation, so the
+# row is identical on the host-only error line.
+METRIC_VERSION = 16
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -336,6 +347,31 @@ SCENARIO_ROWS = [
       "--size", str(1 << 14), "--requests", "128", "--batch", "4",
       "-e", "1", "--storm-events", "6", "--seed", "42"]),
 ]
+
+# Tenant-week rows (ISSUE 19): the pinned 3-tenant compressed week —
+# diurnal client streams merged on one timeline, the noisy tenant's
+# burst storm clamped at the door by its mClock limit tag, four
+# staged disasters healing byte-identically — as a deterministic
+# EventClock simulation (--workload tenant-week;
+# ceph_tpu/scenario/week.py, docs/SCENARIOS.md).  Correctness
+# (converged + byte-identical heal + byte-verified stream) and the
+# isolation gate (victims' p99/miss-rate vs isolated baselines) gate
+# in-workload; the row's victim_gbps_under_slo is the bench_diff
+# `tenant_isolation` series, so noisy-neighbor leakage cannot
+# silently regress.
+TENANT_WEEK_ROWS = [
+    ("tenant_week_isolation",
+     ["--workload", "tenant-week", "--device", "host",
+      "--iterations", "2", "--seed", "17"]),
+]
+
+TENANT_WEEK_ROW_FIELDS = (
+    "gbps_under_slo", "victim_gbps_under_slo", "deadline_miss_rate",
+    "arbiter_enabled", "isolation_ok", "isolation_victims",
+    "tenants", "disasters_healed", "fence_deferrals",
+    "recovery_rounds", "scrub_ticks", "churn_events",
+    "requests_offered", "dispatched", "dispatch_crc", "verified")
+
 
 # Device-chaos rows (ISSUE 13): batched recovery through the
 # supervised fused-repair seam while a seeded DispatchFault script
@@ -501,6 +537,24 @@ def _scenario_rows(host_only: bool = False,
         except Exception as e:  # noqa: BLE001 - recorded, never fatal
             rows[name] = None
             print(f"scenario/{name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return rows
+
+
+def _tenant_week_rows(host_only: bool = False) -> dict:
+    # the week is a deterministic host-clock simulation either way;
+    # host_only is accepted for driver symmetry only
+    rows = {}
+    for name, argv in TENANT_WEEK_ROWS:
+        try:
+            res = _run(list(argv))
+            row = _row_result(res)
+            for f in TENANT_WEEK_ROW_FIELDS:
+                row[f] = res.get(f)
+            rows[name] = row
+        except Exception as e:  # noqa: BLE001 - recorded, never fatal
+            rows[name] = None
+            print(f"tenant-week/{name}: {type(e).__name__}: {e}",
                   file=sys.stderr)
     return rows
 
@@ -760,6 +814,7 @@ def _error_line(msg: str, cpp_gbps: float, cpp_src: str,
         "cluster_rows": _cluster_rows(host_only=True),
         "profile_rows": _profile_rows(host_only=True),
         "scenario_rows": _scenario_rows(host_only=True, requests=64),
+        "tenant_week_rows": _tenant_week_rows(host_only=True),
         "device_chaos_rows": _device_chaos_rows(host_only=True),
         "host_chaos_rows": _host_chaos_rows(host_only=True),
         "autotune_rows": _autotune_rows(host_only=True),
@@ -974,6 +1029,7 @@ def main() -> int:
         "cluster_rows": _cluster_rows(),
         "profile_rows": _profile_rows(),
         "scenario_rows": _scenario_rows(),
+        "tenant_week_rows": _tenant_week_rows(),
         "device_chaos_rows": _device_chaos_rows(),
         "host_chaos_rows": _host_chaos_rows(),
         "autotune_rows": _autotune_rows(),

@@ -833,6 +833,20 @@ def _build_scenario_runner() -> Built:
     return Built(scenario_selftest, (), scenario_selftest)
 
 
+def _build_week_runner() -> Built:
+    """The multi-tenant compressed week as a host-tier entry
+    (ISSUE 19): per-tenant diurnal streams under the per-tenant
+    mClock door, discrete-event fast-forward, and all four staged
+    disasters (rack/backend/host loss + burst storm) healing
+    byte-identically — end to end on an EventClock, ZERO jax
+    compiles, zero device arrays, forever.  Week orchestration is
+    host bookkeeping by construction; its only device seams are the
+    already-audited serve/engine programs."""
+    from ..scenario.week import week_selftest
+
+    return Built(week_selftest, (), week_selftest)
+
+
 def _build_supervisor_selftest() -> Built:
     """The supervised dispatch plane as a host-tier entry (ISSUE 13):
     the full classification ladder — transient retry, OOM rung split,
@@ -1068,6 +1082,11 @@ def registry() -> Tuple[EntryPoint, ...]:
                    _build_scenario_runner, allow=None, trace_budget=0),
         EntryPoint("scenario.qos", "scenario", "host",
                    _build_scenario_qos, allow=None, trace_budget=0),
+        # the multi-tenant compressed week (ISSUE 19): discrete-event
+        # orchestration + per-tenant mClock + staged disasters are
+        # host scheduling forever — 0 compiles, 0 device arrays
+        EntryPoint("scenario.week", "scenario", "host",
+                   _build_week_runner, allow=None, trace_budget=0),
         # the supervised dispatch plane (ISSUE 13): the supervisor is
         # host control flow forever (0 compiles, 0 device arrays),
         # and the supervised fused-repair seam's program is the raw

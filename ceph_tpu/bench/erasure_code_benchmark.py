@@ -134,6 +134,7 @@ class ErasureCodeBench:
                                  "repair-batched", "recovery-churn",
                                  "serving", "multichip", "cluster",
                                  "profile", "scenario",
+                                 "tenant-week",
                                  "device-chaos", "host-chaos",
                                  "autotune"])
         ap.add_argument("-i", "--iterations", type=int, default=1)
@@ -1349,6 +1350,77 @@ class ErasureCodeBench:
         res["verified"] = True
         return res
 
+    # -- tenant-week (the multi-tenant compressed week: per-tenant
+    # diurnal streams under the per-tenant mClock door, discrete-event
+    # fast-forward, staged correlated disasters — ISSUE 19,
+    # ceph_tpu/scenario/week.py, docs/SCENARIOS.md) ---------------------
+
+    def tenant_week_workload(self) -> dict:
+        """Multi-tenant isolation numbers (metric_version 16): the
+        pinned 3-tenant compressed week — diurnal streams merged on
+        one arrival timeline, the noisy tenant's burst storm clamped
+        at the door by its mClock limit tag, all four staged
+        disasters healing byte-identically — runs as a discrete-event
+        simulation on an EventClock (the service model charges
+        modeled time, so every number is deterministic from the
+        seed).  The row carries per-tenant scorecards plus the
+        isolation-gate verdict against per-tenant isolated baselines;
+        ``--no-arbiter`` is the control arm that must FAIL that gate.
+        Correctness gates in-workload: recovery converged, heal
+        byte-identical, every served request byte-verified."""
+        from ..scenario import (isolated_baseline, isolation_gate,
+                                run_tenant_week, tenant_week_scenario)
+        a = self.args
+        # the bench row runs the tiny-scale week (the full ~1e5-request
+        # week is the demo's job); scale rides --iterations as days
+        spec = tenant_week_scenario(
+            seed=a.seed, days=max(2, a.iterations), day_s=6.0,
+            peak_rates=(40.0, 30.0, 20.0), burst_factor=80.0)
+        run = run_tenant_week(spec,
+                              enable_arbiter=not a.no_arbiter)
+        rep = run.report
+        g = rep.gates
+        if not (g["converged"] and g["healed"]
+                and g["verified_requests"]):
+            raise RuntimeError(f"tenant-week gates failed: {g}")
+        victims = tuple(t.name for t in spec.tenants
+                        if t.limit == 0.0)
+        base = {n: isolated_baseline(spec, n) for n in victims}
+        gate = isolation_gate(rep, base, victims=victims)
+        if not a.no_arbiter and not gate["ok"]:
+            raise RuntimeError(
+                f"tenant-week isolation gate failed: {gate}")
+        res = self._result("tenant-week", rep.slo["elapsed_s"],
+                           rep.slo["bytes"])
+        res["lat_p50_ms"] = rep.slo["p50_ms"]
+        res["lat_p99_ms"] = rep.slo["p99_ms"]
+        res["lat_p999_ms"] = rep.slo["p999_ms"]
+        res["lat_samples"] = rep.slo["requests"]
+        res["gbps_under_slo"] = rep.gbps_under_slo
+        res["deadline_miss_rate"] = rep.deadline_miss_rate
+        res["arbiter_enabled"] = rep.arbiter_enabled
+        res["requests_offered"] = g["requests_offered"]
+        res["dispatched"] = g["dispatched"]
+        res["dispatch_crc"] = g["dispatch_crc"]
+        res["tenants"] = rep.tenants
+        # victims' GB/s-under-SLO with the burst storm raging is THE
+        # isolation number (bench_diff `tenant_isolation` series)
+        res["victim_gbps_under_slo"] = sum(
+            (rep.tenants.get(n, {}).get("gbps_under_slo") or 0.0)
+            for n in victims)
+        res["isolation_ok"] = gate["ok"]
+        res["isolation_victims"] = gate["victims"]
+        res["disasters"] = rep.disasters
+        res["disasters_healed"] = all(
+            d["healed"] for d in rep.disasters)
+        res["fence_deferrals"] = sum(
+            d["fence_deferrals"] for d in rep.disasters)
+        res["recovery_rounds"] = rep.recovery_rounds
+        res["scrub_ticks"] = rep.scrub_ticks
+        res["churn_events"] = rep.churn["events"]
+        res["verified"] = True
+        return res
+
     # -- profile (the device-plane profiler: per-program cost/roofline
     # attribution for the engine's cached programs — ISSUE 10,
     # telemetry/profiler.py, docs/OBSERVABILITY.md) ---------------------
@@ -1852,6 +1924,8 @@ class ErasureCodeBench:
             return self.profile_workload()
         if self.args.workload == "scenario":
             return self.scenario_workload()
+        if self.args.workload == "tenant-week":
+            return self.tenant_week_workload()
         if self.args.workload == "device-chaos":
             return self.device_chaos()
         if self.args.workload == "host-chaos":

@@ -106,11 +106,17 @@ class MapChurn:
     max_events: Optional[int] = None
     stages: Optional[Sequence[str]] = None
     avoid_osds: Sequence[int] = ()
+    # maps at or below this width use the legacy full live-set scan
+    # (exact RNG schedule preserved for every existing seed); wider
+    # maps pick victims by bounded seeded probes instead — a 100k-OSD
+    # map must not pay an O(max_osd) scan per churn event
+    scan_limit: int = 32768
     # runtime state (all derived deterministically from the seed)
     steps: int = 0
     events: List[dict] = field(default_factory=list)
     incrementals: List[object] = field(default_factory=list)
     downed: List[int] = field(default_factory=list)
+    scan_fallbacks: int = 0
 
     def __post_init__(self) -> None:
         self._rng = np.random.default_rng(self.seed)
@@ -158,9 +164,58 @@ class MapChurn:
         osd = next(iter(payload["new_state"]))
         return f"osd.{osd}"
 
+    # probe budget above scan_limit: on a map where even 1% of OSDs
+    # are live, 64 uniform draws miss them all with p < 1e-28 — the
+    # counted full-scan fallback is for pathological maps only
+    _PROBE_TRIES = 64
+
+    def _pick_live_probe(self, osdmap, avoid) -> Optional[int]:
+        for _ in range(self._PROBE_TRIES):
+            o = int(self._rng.integers(0, osdmap.max_osd))
+            if osdmap.is_up(o) and not osdmap.is_out(o) \
+                    and o not in avoid:
+                return o
+        self.scan_fallbacks += 1
+        live = [o for o in range(osdmap.max_osd)
+                if osdmap.is_up(o) and not osdmap.is_out(o)
+                and o not in avoid]
+        if not live:
+            return None
+        return int(live[int(self._rng.integers(0, len(live)))])
+
+    def _draw_event_probe(self, osdmap):
+        """Wide-map event draw: same event kinds, victim picked by
+        seeded probes instead of materializing the live set."""
+        from ..crush.incremental import CEPH_OSD_UP
+        from ..crush.osdmap import IN_WEIGHT
+        avoid = set(int(o) for o in self.avoid_osds)
+        kinds = []
+        if self.downed:
+            kinds.append("revive")
+        if len(self.downed) < self.max_down:
+            kinds.append("down")
+        kinds.append("reweight")
+        kind = kinds[int(self._rng.integers(0, len(kinds)))]
+        if kind == "revive":
+            osd = self.downed.pop(
+                int(self._rng.integers(0, len(self.downed))))
+            return "revive", {"new_state": {osd: CEPH_OSD_UP},
+                              "new_weight": {osd: IN_WEIGHT}}
+        osd = self._pick_live_probe(osdmap, avoid)
+        if osd is None:
+            return None
+        if kind == "down":
+            self.downed.append(osd)
+            return "down", {"new_state": {osd: CEPH_OSD_UP},
+                            "new_weight": {osd: 0}}
+        w = int(self._rng.integers(IN_WEIGHT // 2, IN_WEIGHT + 1))
+        return "reweight", {"new_weight": {osd: w}}
+
     def _draw_event(self, osdmap):
         from ..crush.incremental import CEPH_OSD_UP
         from ..crush.osdmap import IN_WEIGHT
+        if osdmap.max_osd > self.scan_limit:
+            return self._draw_event_probe(osdmap)
         avoid = set(int(o) for o in self.avoid_osds)
         live = [o for o in range(osdmap.max_osd)
                 if osdmap.is_up(o) and not osdmap.is_out(o)

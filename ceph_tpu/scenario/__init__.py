@@ -41,17 +41,33 @@ from .runner import (  # noqa: F401
     stage_damaged_objects,
 )
 from .spec import (  # noqa: F401
+    DISASTER_KINDS,
     QOS_CLASSES,
     ChaosSchedule,
+    DisasterSchedule,
+    DisasterStage,
     QosSpec,
     ScenarioSpec,
+    TenantSpec,
     default_scenario,
+    tenant_week_scenario,
+)
+from .week import (  # noqa: F401
+    TenantWeekRun,
+    isolated_baseline,
+    isolation_gate,
+    run_tenant_week,
+    week_selftest,
+    week_service_model,
 )
 
 __all__ = [
-    "ChaosSchedule", "MClockArbiter", "QOS_CLASSES", "QosSpec",
-    "ScenarioReport", "ScenarioRun", "ScenarioSpec", "default_scenario",
-    "drain_churn", "drive_storm", "qos_selftest", "run_scenario",
-    "run_serving_scenario", "scenario_selftest",
-    "stage_damaged_objects",
+    "ChaosSchedule", "DISASTER_KINDS", "DisasterSchedule",
+    "DisasterStage", "MClockArbiter", "QOS_CLASSES", "QosSpec",
+    "ScenarioReport", "ScenarioRun", "ScenarioSpec", "TenantSpec",
+    "TenantWeekRun", "default_scenario", "drain_churn", "drive_storm",
+    "isolated_baseline", "isolation_gate", "qos_selftest",
+    "run_scenario", "run_serving_scenario", "run_tenant_week",
+    "scenario_selftest", "stage_damaged_objects",
+    "tenant_week_scenario", "week_selftest", "week_service_model",
 ]

@@ -84,6 +84,14 @@ class MClockArbiter:
                         else enabled)
         self._state: Dict[str, _ClassState] = {
             c: _ClassState() for c in CLASSES}
+        # per-(tenant, client) foreground tags (ISSUE 19): each
+        # registered tenant carries its own reservation/weight/limit
+        # triple over the standard mClock recurrence.  Foreground tags
+        # are NEVER multiplied by the background scale — a tenant is
+        # capped by its own limit, not throttled by cluster pressure —
+        # and the reservation phase always grants, so a tenant's
+        # guaranteed floor cannot be starved by any neighbor.
+        self._tenants: Dict[str, dict] = {}
         self._window: List[int] = []
         self._misses = 0
         self.scale_min = 1.0
@@ -197,6 +205,82 @@ class MClockArbiter:
                       pressure=self.pressure(), scale=scale)
         return False
 
+    # -- per-tenant foreground admission (ISSUE 19) ----------------------
+
+    def register_tenant(self, name: str, reservation: float = 0.0,
+                        weight: float = 1.0,
+                        limit: float = 0.0) -> None:
+        """Register one tenant's (reservation, weight, limit) client
+        tags.  ``limit`` ops/s is the hard ceiling — the noisy-
+        neighbor clamp; 0 = uncapped.  ``reservation`` ops/s is the
+        guaranteed floor (accounting: those grants are reservation-
+        phase, never deniable); ``weight`` paces the proportional
+        share at ``weight_rate`` ops/s per unit."""
+        self._tenants[str(name)] = {
+            "reservation": float(reservation),
+            "weight": float(weight), "limit": float(limit),
+            "st": _ClassState()}
+
+    def admit_tenant(self, name: str,
+                     now: Optional[float] = None) -> bool:
+        """Front-door admission for one tenant client request.  The
+        ONLY denial is the tenant's own limit tag (mClock's hard
+        ceiling): a request inside the limit is granted — via the
+        reservation phase while the reservation tag is due, else the
+        weight phase — because a foreground request past its weight
+        pacing still deserves service on an idle system; the limit is
+        what isolates neighbors.  Disabled arbiter (the control) and
+        unregistered tenants always pass."""
+        ts = self._tenants.get(name)
+        if ts is None or not self.enabled:
+            self._state[CLIENT].grants += 1
+            return True
+        if now is None:
+            now = self.clock.monotonic()
+        st = ts["st"]
+        if st.r_tag is None:
+            st.r_tag = st.p_tag = st.l_tag = now
+        limit = ts["limit"]
+        if limit > 0 and st.l_tag > now:
+            st.denials["limit"] = st.denials.get("limit", 0) + 1
+            tel.counter("qos_denials", cls=CLIENT, tenant=name,
+                        reason="limit")
+            if tracing.enabled():
+                tracing.active().add_qos(
+                    f"client:{name}", False, "limit", now,
+                    pressure=self.pressure(), scale=1.0)
+            return False
+        res = ts["reservation"]
+        if res > 0 and st.r_tag <= now:
+            st.r_tag = max(st.r_tag, now) + 1.0 / res
+            st.reservation_grants += 1
+            phase = "reservation"
+        else:
+            phase = "weight"
+        rate = ts["weight"] * self.spec.weight_rate
+        if rate > 0:
+            st.p_tag = max(st.p_tag, now) + 1.0 / rate
+        if limit > 0:
+            st.l_tag = max(st.l_tag, now) + 1.0 / limit
+        st.grants += 1
+        tel.counter("qos_grants", cls=CLIENT, tenant=name,
+                    phase=phase)
+        return True
+
+    def tenant_hold(self, name: str,
+                    now: Optional[float] = None) -> float:
+        """Seconds until ``name``'s limit tag would next admit (0 =
+        admissible now) — the deterministic shed-retry horizon."""
+        ts = self._tenants.get(name)
+        if ts is None or not self.enabled:
+            return 0.0
+        st = ts["st"]
+        if st.l_tag is None or ts["limit"] <= 0:
+            return 0.0
+        if now is None:
+            now = self.clock.monotonic()
+        return max(0.0, st.l_tag - now)
+
     def hold_for(self, cls: str, now: Optional[float] = None) -> float:
         """Seconds until ``cls`` could next be granted (0 when it
         would pass right now) — the deterministic drain back-off."""
@@ -231,6 +315,20 @@ class MClockArbiter:
                 "reservation_grants": st.reservation_grants,
                 "denials": dict(sorted(st.denials.items())),
             }
+        if self._tenants:
+            # per-tenant accounting only when tenants are registered —
+            # single-tenant snapshots stay byte-identical
+            out["tenants"] = {}
+            for name in sorted(self._tenants):
+                ts = self._tenants[name]
+                st = ts["st"]
+                out["tenants"][name] = {
+                    "reservation": ts["reservation"],
+                    "weight": ts["weight"], "limit": ts["limit"],
+                    "grants": st.grants,
+                    "reservation_grants": st.reservation_grants,
+                    "denials": dict(sorted(st.denials.items())),
+                }
         return out
 
 

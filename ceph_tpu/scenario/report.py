@@ -53,6 +53,13 @@ class ScenarioReport:
     # journal_redispatches) and the plane topology before/after; None
     # when the spec armed no host-plane chaos
     host_plane: Optional[dict] = None
+    # multi-tenant week (ISSUE 19, scenario/week.py): per-tenant SLO
+    # scorecards keyed by tenant name, and the staged-disaster
+    # timeline (one entry per DisasterStage with arm/fire/heal times
+    # + per-stage gates); None outside week runs so every pre-week
+    # report JSON stays byte-identical
+    tenants: Optional[dict] = None
+    disasters: Optional[List[dict]] = None
 
     # -- convenience accessors (the contention axes) ---------------------
 
@@ -99,6 +106,10 @@ class ScenarioReport:
             out["supervisor"] = self.supervisor
         if self.host_plane is not None:
             out["host_plane"] = self.host_plane
+        if self.tenants is not None:
+            out["tenants"] = self.tenants
+        if self.disasters is not None:
+            out["disasters"] = self.disasters
         return out
 
     def to_json(self) -> str:

@@ -145,7 +145,11 @@ def run_serving_scenario(spec, clock=None, executor: str = "device",
         while i < len(reqs) or batcher.pending() or len(queue):
             now = clock.monotonic()
             while i < len(reqs) and arrivals[i] <= now:
-                queue.submit(reqs[i])
+                # an open-loop arrival shed at the door IS a miss for
+                # that request — the closed loop below retries instead
+                # (its submit-False is backpressure, not a shed)
+                if not queue.submit(reqs[i]):
+                    sla.record_reject(reqs[i], "capacity")
                 i += 1
             fired = batcher.poll(queue)
             _absorb(fired)

@@ -28,8 +28,9 @@ so a scenario JSON checked into a bug report IS the reproducer.
 from __future__ import annotations
 
 import json
+import math
 from dataclasses import asdict, dataclass, field, replace
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ..cluster.topology import ClusterSpec
 from ..serve.loadgen import CodecSpec, TrafficSpec
@@ -102,6 +103,108 @@ class ChaosSchedule:
 
 
 @dataclass(frozen=True)
+class TenantSpec:
+    """One tenant in a multi-tenant scenario (ISSUE 19): its own
+    seeded traffic stream, per-op deadlines (the SloPolicy rides the
+    TrafficSpec), mClock client tags, and an optional causal-trace
+    sampling rate (telemetry/tracing.py per-tenant affordability).
+
+    ``reservation``/``limit`` are ops/s (0 = none); ``weight`` is the
+    proportional share.  The limit is THE isolation contract: a
+    tenant bursting past it is rejected at the door (counted against
+    its own scorecard), never served at its neighbors' expense."""
+
+    name: str
+    traffic: TrafficSpec
+    reservation: float = 0.0
+    weight: float = 1.0
+    limit: float = 0.0
+    trace_sample: float = 1.0
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "traffic": self.traffic.to_dict(),
+                "reservation": self.reservation, "weight": self.weight,
+                "limit": self.limit, "trace_sample": self.trace_sample}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantSpec":
+        return cls(name=d["name"],
+                   traffic=TrafficSpec.from_dict(d["traffic"]),
+                   reservation=d.get("reservation", 0.0),
+                   weight=d.get("weight", 1.0),
+                   limit=d.get("limit", 0.0),
+                   trace_sample=d.get("trace_sample", 1.0))
+
+
+# disaster-stage catalogue (docs/SCENARIOS.md): what each kind does at
+# fire time and undoes at heal time (scenario/week.py runs the
+# arm -> fire -> heal machine with a flight-recorder dump per stage)
+DISASTER_KINDS = ("rack_loss", "host_loss", "backend_loss",
+                  "tenant_burst")
+
+
+@dataclass(frozen=True)
+class DisasterStage:
+    """One staged correlated disaster on the week timeline.
+
+    ``at_s`` is the fire time on the scenario clock (stream-relative),
+    ``duration_s`` the fire->heal window, ``arm_lead_s`` how far ahead
+    the stage arms (the flight recorder notes the arm so the dump
+    brackets the whole incident).  Kind-specific knobs: ``rack`` /
+    ``host`` pick the blast radius for the loss kinds, ``tenant`` +
+    ``factor`` shape the burst storm, ``objects`` is how many
+    recovery objects the loss damages."""
+
+    kind: str
+    at_s: float
+    duration_s: float = 1.0
+    arm_lead_s: float = 0.5
+    rack: int = 0
+    host: int = 0
+    tenant: str = ""
+    factor: float = 8.0
+    objects: int = 2
+    # the supervised seam backend_loss faults ride: the week runner
+    # dispatches its heal-phase recovery rounds through this seam, so
+    # the injected faults and the retry ladder that survives them are
+    # both on the record (ops/supervisor.py counters)
+    seam: str = "week.recovery"
+
+    def __post_init__(self) -> None:
+        if self.kind not in DISASTER_KINDS:
+            raise ValueError(f"disaster kind {self.kind!r} not in "
+                             f"{DISASTER_KINDS}")
+        if self.duration_s <= 0:
+            raise ValueError(f"duration_s {self.duration_s} must be "
+                             f"> 0")
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DisasterStage":
+        return cls(**d)
+
+
+@dataclass(frozen=True)
+class DisasterSchedule:
+    """The week's correlated-disaster timeline: existing adversary
+    planes (map churn downs, host loss, device-plane backend loss,
+    tenant burst storms) composed as arm/fire/heal stages on ONE
+    clock.  A pure value like every other spec half."""
+
+    stages: Tuple[DisasterStage, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {"stages": [s.to_dict() for s in self.stages]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DisasterSchedule":
+        return cls(stages=tuple(DisasterStage.from_dict(s)
+                                for s in d.get("stages", ())))
+
+
+@dataclass(frozen=True)
 class QosSpec:
     """mClock-style per-class tags + the SLO feedback knobs.
 
@@ -166,6 +269,20 @@ class ScenarioSpec:
     scrub_tick_s: float = 0.002
     churn_step_s: float = 0.004
     max_recovery_rounds: int = 200
+    # multi-tenant week (ISSUE 19, scenario/week.py): the tenant
+    # roster and the staged-disaster timeline.  Empty = every
+    # pre-ISSUE-19 scenario JSON (run_scenario ignores both).
+    tenants: Tuple[TenantSpec, ...] = ()
+    disasters: DisasterSchedule = field(
+        default_factory=DisasterSchedule)
+    # the week's timeline cadences: background scrub ticks and churn
+    # epochs fire at these sim-second intervals (0 = never)
+    week_scrub_every_s: float = 0.0
+    week_churn_every_s: float = 0.0
+    # sim dispatch overhead (seconds) for the week's service model —
+    # with service_gbps it fixes the modeled serving capacity the
+    # tenants contend for
+    service_overhead_s: float = 2e-4
 
     def __post_init__(self) -> None:
         if self.traffic is None:
@@ -195,7 +312,7 @@ class ScenarioSpec:
     # -- JSON round trip -------------------------------------------------
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "name": self.name,
             "seed": self.seed,
             "cluster": asdict(self.cluster),
@@ -212,6 +329,15 @@ class ScenarioSpec:
             "churn_step_s": self.churn_step_s,
             "max_recovery_rounds": self.max_recovery_rounds,
         }
+        if self.tenants:
+            # week-only keys appear only on week specs, so every
+            # pre-ISSUE-19 spec JSON round-trips byte-identically
+            out["tenants"] = [t.to_dict() for t in self.tenants]
+            out["disasters"] = self.disasters.to_dict()
+            out["week_scrub_every_s"] = self.week_scrub_every_s
+            out["week_churn_every_s"] = self.week_churn_every_s
+            out["service_overhead_s"] = self.service_overhead_s
+        return out
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), sort_keys=True)
@@ -236,6 +362,13 @@ class ScenarioSpec:
             scrub_tick_s=d["scrub_tick_s"],
             churn_step_s=d["churn_step_s"],
             max_recovery_rounds=d["max_recovery_rounds"],
+            tenants=tuple(TenantSpec.from_dict(t)
+                          for t in d.get("tenants", ())),
+            disasters=DisasterSchedule.from_dict(
+                d.get("disasters", {})),
+            week_scrub_every_s=d.get("week_scrub_every_s", 0.0),
+            week_churn_every_s=d.get("week_churn_every_s", 0.0),
+            service_overhead_s=d.get("service_overhead_s", 2e-4),
         )
 
     @classmethod
@@ -297,5 +430,117 @@ def default_scenario(seed: int = 42, n_requests: int = 128,
                         chaos=chaos, **overrides)
 
 
-__all__ = ["QOS_CLASSES", "ChaosSchedule", "QosSpec", "ScenarioSpec",
-           "default_scenario"]
+def tenant_week_scenario(seed: int = 42, days: int = 7,
+                         day_s: float = 40.0,
+                         peak_rates: Tuple[float, float, float] = (
+                             260.0, 200.0, 140.0),
+                         burst_factor: float = 12.0,
+                         diurnal_min_frac: float = 0.1,
+                         noisy_limit_factor: float = 2.0,
+                         **overrides) -> ScenarioSpec:
+    """The pinned multi-tenant compressed week: three tenants with
+    diurnal arrival curves (10x trough-to-peak swing by default) share
+    one serving plane for ``days`` compressed days of ``day_s`` sim
+    seconds each, while the disaster schedule lands a rack loss at a
+    traffic peak, a backend-seam loss mid-rebalance, a host loss at
+    the next peak, and a noisy-neighbor burst storm.
+
+    Tenant QoS shape: ``alpha``/``bravo`` are the victims — reserved
+    and uncapped — while ``noisy`` carries a limit tag at
+    ``noisy_limit_factor`` times its base peak rate, so its
+    ``burst_factor`` storm is clamped at the door when the arbiter is
+    on and saturates the shared service clock when it is off (the
+    isolation gate's control arm).
+
+    Request counts are derived, not chosen: each stream's
+    ``n_requests`` is the integral of its diurnal rate over the week
+    (plus the burst window's extra arrivals for ``noisy``), so the
+    stream spans the full week at any scale — the tier-1 test runs a
+    2-day miniature and the demo runs the full ~1e5-request week from
+    the SAME factory.
+    """
+    week_s = float(days) * day_s
+    mean_frac = diurnal_min_frac + (1.0 - diurnal_min_frac) * 0.5
+
+    def _frac(t: float) -> float:
+        # the diurnal multiplier at sim-time t (loadgen.diurnal_rate)
+        return diurnal_min_frac + (1.0 - diurnal_min_frac) * 0.5 * (
+            1.0 - math.cos(2.0 * math.pi * t / day_s))
+
+    deadlines = {"encode": 0.06, "decode": 0.06, "repair": 0.12}
+    # stage times are WEEK fractions (day-1.5/2.8/3.5/4.4 of a 7-day
+    # week), not absolute day multiples — a 2-day miniature must land
+    # every disaster inside its compressed week, or the burst storm
+    # plays out after the victim streams already drained
+    burst_at = (4.4 / 7.0) * week_s
+    burst_dur = 0.3 * day_s
+    stages = (
+        DisasterStage(kind="rack_loss", at_s=(1.5 / 7.0) * week_s,
+                      duration_s=0.2 * day_s, arm_lead_s=0.05 * day_s,
+                      rack=1, objects=3),
+        DisasterStage(kind="backend_loss", at_s=(2.8 / 7.0) * week_s,
+                      duration_s=0.1 * day_s, arm_lead_s=0.05 * day_s,
+                      objects=2, seam="week.recovery"),
+        DisasterStage(kind="host_loss", at_s=(3.5 / 7.0) * week_s,
+                      duration_s=0.15 * day_s, arm_lead_s=0.05 * day_s,
+                      host=4, objects=2),
+        DisasterStage(kind="tenant_burst", at_s=burst_at,
+                      duration_s=burst_dur, arm_lead_s=0.05 * day_s,
+                      tenant="noisy", factor=burst_factor),
+    )
+
+    def _stream(name: str, idx: int, rate: float, stripe: int,
+                extra: int = 0) -> TrafficSpec:
+        n = int(rate * mean_frac * week_s) + extra
+        return TrafficSpec(
+            seed=seed + 11 * (idx + 1), n_requests=n,
+            codecs=[CodecSpec(
+                f"rs_k4_m2_{name}", "jerasure",
+                {"technique": "reed_sol_van", "k": "4", "m": "2"},
+                stripe)],
+            op_mix={"encode": 0.7, "decode": 0.25, "repair": 0.05},
+            deadlines=dict(deadlines), arrival="open", rate=rate,
+            erasures=1, ladder=(1, 2, 4, 8),
+            queue_capacity=1 << 16, pool=8, tenant=name,
+            diurnal_period_s=day_s,
+            diurnal_min_frac=diurnal_min_frac)
+
+    r_alpha, r_bravo, r_noisy = (float(r) for r in peak_rates)
+    # the burst window's extra arrivals: rate * diurnal(t_mid) *
+    # (factor - 1) * duration, so the noisy stream still spans the
+    # whole week instead of exhausting early
+    extra = int(r_noisy * _frac(burst_at + 0.5 * burst_dur)
+                * (burst_factor - 1.0) * burst_dur)
+    tenants = (
+        TenantSpec(name="alpha",
+                   traffic=_stream("alpha", 0, r_alpha, 1 << 14),
+                   reservation=0.25 * r_alpha, weight=4.0, limit=0.0,
+                   trace_sample=0.05),
+        TenantSpec(name="bravo",
+                   traffic=_stream("bravo", 1, r_bravo, 1 << 13),
+                   reservation=0.25 * r_bravo, weight=3.0, limit=0.0,
+                   trace_sample=0.02),
+        TenantSpec(name="noisy",
+                   traffic=_stream("noisy", 2, r_noisy, 1 << 15,
+                                   extra=extra),
+                   reservation=0.1 * r_noisy, weight=1.0,
+                   limit=noisy_limit_factor * r_noisy,
+                   trace_sample=0.005),
+    )
+    cluster = ClusterSpec(seed=seed, racks=4, hosts_per_rack=3,
+                          osds_per_host=2, replicated_pg_num=32,
+                          ec_pg_num=16, ec_k=4, ec_m=2)
+    defaults = dict(
+        seed=seed, cluster=cluster, traffic=tenants[0].traffic,
+        tenants=tenants, disasters=DisasterSchedule(stages=stages),
+        week_scrub_every_s=day_s / 8.0,
+        week_churn_every_s=day_s / 5.0,
+        service_gbps=0.5, service_overhead_s=4e-3)
+    defaults.update(overrides)
+    return ScenarioSpec(**defaults)
+
+
+__all__ = ["QOS_CLASSES", "ChaosSchedule", "DisasterSchedule",
+           "DisasterStage", "DISASTER_KINDS", "QosSpec",
+           "ScenarioSpec", "TenantSpec", "default_scenario",
+           "tenant_week_scenario"]

@@ -86,6 +86,16 @@ class TrafficSpec:
     paged: bool = False
     page_size: Optional[int] = None
     pool_pages: Optional[int] = None
+    # multi-tenant weeks (ISSUE 19, scenario/week.py): the tenant
+    # every request in this stream bills against ("" = legacy
+    # single-tenant), and the diurnal open-loop arrival modulation —
+    # rate(t) = rate * (min_frac + (1 - min_frac) * half-cosine over
+    # ``diurnal_period_s``), so ``diurnal_min_frac=0.1`` is the 10x
+    # trough-to-peak traffic swing.  None/1.0 = the flat Poisson
+    # process every pre-ISSUE-19 spec JSON encodes.
+    tenant: str = ""
+    diurnal_period_s: Optional[float] = None
+    diurnal_min_frac: float = 1.0
 
     def __post_init__(self) -> None:
         if self.arrival not in ("closed", "open"):
@@ -107,6 +117,9 @@ class TrafficSpec:
             "queue_capacity": self.queue_capacity, "pool": self.pool,
             "paged": self.paged, "page_size": self.page_size,
             "pool_pages": self.pool_pages,
+            "tenant": self.tenant,
+            "diurnal_period_s": self.diurnal_period_s,
+            "diurnal_min_frac": self.diurnal_min_frac,
         }
 
     @classmethod
@@ -121,7 +134,10 @@ class TrafficSpec:
             queue_capacity=d["queue_capacity"], pool=d["pool"],
             paged=bool(d.get("paged", False)),
             page_size=d.get("page_size"),
-            pool_pages=d.get("pool_pages"))
+            pool_pages=d.get("pool_pages"),
+            tenant=d.get("tenant", ""),
+            diurnal_period_s=d.get("diurnal_period_s"),
+            diurnal_min_frac=d.get("diurnal_min_frac", 1.0))
 
 
 def default_spec(seed: int = 42, n_requests: int = 256,
@@ -202,21 +218,54 @@ class _CodecState:
         return pats
 
 
-class LoadGenerator:
-    """Deterministic request-stream factory for a TrafficSpec."""
+def diurnal_rate(spec: TrafficSpec, t: float,
+                 boost=None) -> float:
+    """Instantaneous open-loop arrival rate at stream offset ``t``:
+    the base rate shaped by the spec's diurnal half-cosine (trough at
+    t=0, peak at half period) and an optional ``boost(t)`` multiplier
+    (scenario/week.py's tenant-burst disaster stage)."""
+    lam = spec.rate
+    if spec.diurnal_period_s and spec.diurnal_min_frac < 1.0:
+        frac = 0.5 * (1.0 - np.cos(
+            2.0 * np.pi * t / spec.diurnal_period_s))
+        lam *= (spec.diurnal_min_frac
+                + (1.0 - spec.diurnal_min_frac) * frac)
+    if boost is not None:
+        lam *= boost(t)
+    return float(lam)
 
-    def __init__(self, spec: TrafficSpec) -> None:
+
+class LoadGenerator:
+    """Deterministic request-stream factory for a TrafficSpec.
+
+    ``share_payloads`` (week-scale streams, scenario/week.py):
+    requests reference the generator's pooled arrays instead of
+    copying them — every consumer (the batcher stacks payloads into a
+    fresh dispatch buffer; the pool pages copy on write) treats
+    payloads as read-only, so sharing is safe and turns a million-
+    request stream from gigabytes into the pool's footprint."""
+
+    def __init__(self, spec: TrafficSpec,
+                 share_payloads: bool = False) -> None:
         self.spec = spec
+        self.share_payloads = bool(share_payloads)
+        self._shared: Dict[tuple, tuple] = {}
         self.states = [
             _CodecState(c, seed=spec.seed + 7919 * i,
                         erasures=spec.erasures, pool=spec.pool)
             for i, c in enumerate(spec.codecs)]
 
-    def generate(self) -> Tuple[List[EcRequest], Optional[List[float]]]:
+    def generate(self, boost=None
+                 ) -> Tuple[List[EcRequest], Optional[List[float]]]:
         """(requests, arrival offsets).  Offsets are cumulative
         seconds from stream start for open-loop arrival, None for
         closed loop.  Request ids are 0..n-1 (stream order) so two
-        runs of one seed log identical batch compositions."""
+        runs of one seed log identical batch compositions.
+
+        ``boost``: optional ``t -> multiplier`` on the open-loop rate
+        (the tenant-burst stage).  With no boost and no diurnal shape
+        the offsets are byte-identical to the legacy flat-Poisson
+        draw."""
         spec = self.spec
         rng = np.random.default_rng(spec.seed)
         ops = sorted(spec.op_mix)
@@ -234,9 +283,24 @@ class LoadGenerator:
                                    req_id=i))
         offsets = None
         if spec.arrival == "open":
-            gaps = rng.exponential(1.0 / spec.rate,
-                                   size=spec.n_requests)
-            offsets = list(np.cumsum(gaps))
+            shaped = (boost is not None
+                      or (spec.diurnal_period_s
+                          and spec.diurnal_min_frac < 1.0))
+            if shaped:
+                # inhomogeneous Poisson via sequential gap scaling:
+                # gap_i = Exp(1) / rate(t_i) — deterministic from the
+                # same rng stream, replayable like the flat draw
+                unit = rng.exponential(1.0, size=spec.n_requests)
+                offsets = []
+                t = 0.0
+                for g in unit:
+                    lam = max(diurnal_rate(spec, t, boost), 1e-9)
+                    t += float(g) / lam
+                    offsets.append(t)
+            else:
+                gaps = rng.exponential(1.0 / spec.rate,
+                                       size=spec.n_requests)
+                offsets = list(np.cumsum(gaps))
         return reqs, offsets
 
     def _make(self, st: _CodecState, op: str, j: int, pat_idx: int,
@@ -244,23 +308,34 @@ class LoadGenerator:
         codec = st.codec
         work = st.k * st.chunk
         if op == "encode":
+            payload = (st.data[j] if self.share_payloads
+                       else st.data[j].copy())
             return EcRequest(
                 op=op, plugin=codec.plugin, profile=codec.profile,
                 stripe_size=codec.stripe_size,
-                payload=st.data[j].copy(), req_id=req_id,
-                work_bytes=work, expect=st.parity[j])
+                payload=payload, req_id=req_id,
+                work_bytes=work, expect=st.parity[j],
+                tenant=self.spec.tenant)
         erased = st.patterns[pat_idx]
         available = tuple(x for x in range(st.n) if x not in erased)
-        survivors = np.ascontiguousarray(
-            st.allchunks[j, list(available), :])
-        rec_expect = st.allchunks[j, list(erased), :]
+        key = (id(st), j, erased)
+        shared = self._shared.get(key) if self.share_payloads else None
+        if shared is None:
+            survivors = np.ascontiguousarray(
+                st.allchunks[j, list(available), :])
+            rec_expect = st.allchunks[j, list(erased), :]
+            if self.share_payloads:
+                self._shared[key] = (survivors, rec_expect)
+        else:
+            survivors, rec_expect = shared
         expect = (rec_expect if op == "decode"
                   else (rec_expect, st.parity[j]))
         return EcRequest(
             op=op, plugin=codec.plugin, profile=codec.profile,
             stripe_size=codec.stripe_size, payload=survivors,
             available=available, erased=erased, req_id=req_id,
-            work_bytes=work, expect=expect)
+            work_bytes=work, expect=expect,
+            tenant=self.spec.tenant)
 
 
 # ----------------------------------------------------------------------

@@ -81,6 +81,10 @@ class EcRequest:
     # when a collector is installed AND the deterministic sampling
     # draw passes; None otherwise — every downstream hook gates on it
     trace: object = None
+    # multi-tenant scenarios (scenario/week.py): the tenant this
+    # request bills against; "" = the single-tenant legacy streams,
+    # where nothing downstream consults it
+    tenant: str = ""
 
     def __post_init__(self) -> None:
         if self.op not in OPS:
@@ -154,9 +158,16 @@ class AdmissionQueue:
             else:
                 self.rejected += 1
         if not admitted_now:
-            tel.counter("serve_rejected", op=req.op)
+            # serve_rejected carries tenant + reason so multi-tenant
+            # overload shedding is attributable at the door (the SLO
+            # ledger separately counts the reject as a miss —
+            # serve/sla.py::record_reject — so shedding can never
+            # flatter the miss rate)
+            tel.counter("serve_rejected", op=req.op,
+                        tenant=req.tenant, reason="capacity")
             tel.event("serve_admission_reject", op=req.op,
-                      req_id=req.req_id, depth=depth)
+                      req_id=req.req_id, depth=depth,
+                      tenant=req.tenant, reason="capacity")
             return False
         tel.counter("serve_admitted", op=req.op)
         tel.gauge("serve_queue_depth", depth)

@@ -158,6 +158,37 @@ class SlaRecorder:
         self.misses: Dict[str, int] = {}
         self.ok_bytes: Dict[str, int] = {}
         self.total_bytes: Dict[str, int] = {}
+        # rejected-at-the-door accounting (ISSUE 19): op -> reason ->
+        # count.  A reject IS a deadline miss — the request got
+        # nothing by its deadline — so report() folds these into the
+        # miss-rate denominators; a recorder that never sees a reject
+        # reports byte-identically to before.
+        self.rejects: Dict[str, Dict[str, int]] = {}
+        # per-tenant scorecard ledgers ("" requests bill no tenant)
+        self._tenant: Dict[str, dict] = {}
+
+    def _tenant_slot(self, name: str) -> dict:
+        t = self._tenant.get(name)
+        if t is None:
+            t = self._tenant[name] = {
+                "hist": LatencyHistogram(), "count": 0, "misses": 0,
+                "ok_bytes": 0, "total_bytes": 0, "rejects": {}}
+        return t
+
+    def record_reject(self, req, reason: str = "capacity") -> None:
+        """Fold one front-door rejection into the ledger: counted as
+        a deadline miss against its op class AND its tenant, never
+        silently shed (the satellite fix — rejected requests used to
+        vanish from the scorecard entirely)."""
+        op = req.op
+        by_reason = self.rejects.setdefault(op, {})
+        by_reason[reason] = by_reason.get(reason, 0) + 1
+        self.monitor.record(op, False)
+        tenant = getattr(req, "tenant", "")
+        if tenant:
+            t = self._tenant_slot(tenant)
+            t["rejects"][reason] = t["rejects"].get(reason, 0) + 1
+        tel.counter("serve_deadline_miss", op=op, rejected="1")
 
     def record(self, result: EcResult) -> None:
         op = result.request.op
@@ -187,6 +218,16 @@ class SlaRecorder:
         # mirror into the unified metrics plane (perf dump / prom)
         tel.observe("serve_request_seconds", result.latency,
                     exemplar=tid, op=op)
+        tenant = getattr(result.request, "tenant", "")
+        if tenant:
+            t = self._tenant_slot(tenant)
+            t["hist"].record(result.latency, exemplar=tid)
+            t["count"] += 1
+            t["total_bytes"] += result.request.work_bytes
+            if result.deadline_met:
+                t["ok_bytes"] += result.request.work_bytes
+            else:
+                t["misses"] += 1
 
     # -- readout ---------------------------------------------------------
 
@@ -205,20 +246,32 @@ class SlaRecorder:
         up (and the batcher's padding accounting when provided).
         Deterministic: dict insertion order is sorted, every derived
         float is rounded."""
-        ops = sorted(self.count)
+        ops = sorted(set(self.count) | set(self.rejects))
         per_op = {}
         for op in ops:
-            n = self.count[op]
+            n = self.count.get(op, 0)
+            rej = sum(self.rejects.get(op, {}).values())
+            denom = n + rej
             per_op[op] = {
                 "requests": n,
-                "deadline_miss_rate": round(self.misses[op] / n, 6),
-                "bytes": self.total_bytes[op],
+                "deadline_miss_rate": (
+                    round((self.misses.get(op, 0) + rej) / denom, 6)
+                    if denom else None),
+                "bytes": self.total_bytes.get(op, 0),
                 "gbps_under_slo": (
-                    round(self.ok_bytes[op] / elapsed / 1e9, 6)
+                    round(self.ok_bytes.get(op, 0) / elapsed / 1e9, 6)
                     if elapsed > 0 else None),
                 **self._pcts(self._hist.get(op)),
                 "queue_wait": self._pcts(self._wait.get(op)),
             }
+            if rej:
+                # rejects fold into the miss rate above; the key only
+                # appears when a reject happened, so legacy reports
+                # serialize byte-identically
+                per_op[op]["rejected"] = dict(
+                    sorted(self.rejects[op].items()))
+            if op not in self.count:
+                continue
             exemplars = self._hist[op].exemplars()
             if exemplars:
                 # top-quantile samples with their trace ids (only
@@ -237,16 +290,19 @@ class SlaRecorder:
         total_bytes = sum(self.total_bytes.values())
         ok_bytes = sum(self.ok_bytes.values())
         misses = sum(self.misses.values())
+        rejected = sum(sum(r.values()) for r in self.rejects.values())
+        denom = total + rejected
         # all-ops roll-up: bucket-exact merge of the per-class
         # histograms (same log2 grid, so counts just add)
         merged = LatencyHistogram()
         for op in ops:
-            merged.merge(self._hist[op])
+            if op in self._hist:
+                merged.merge(self._hist[op])
         out = {
             "elapsed_s": round(elapsed, 6),
             "requests": total,
-            "deadline_miss_rate": (round(misses / total, 6)
-                                   if total else None),
+            "deadline_miss_rate": (round((misses + rejected) / denom, 6)
+                                   if denom else None),
             "bytes": total_bytes,
             "gbps": (round(total_bytes / elapsed / 1e9, 6)
                      if elapsed > 0 else None),
@@ -255,6 +311,38 @@ class SlaRecorder:
             **self._pcts(merged if merged.count else None),
             "op_classes": per_op,
         }
+        if rejected:
+            out["rejected_misses"] = rejected
+        if self._tenant:
+            out["tenants"] = self.tenant_report(elapsed)
         if padding is not None:
             out["padding"] = dict(sorted(padding.items()))
+        return out
+
+    def tenant_report(self, elapsed: float) -> dict:
+        """Per-tenant scorecards: served/rejected counts, the
+        miss rate WITH rejects folded in, latency percentiles and
+        GB/s-under-SLO — the isolation gate's per-victim evidence.
+        Deterministic like the rest of the report."""
+        out = {}
+        for name in sorted(self._tenant):
+            t = self._tenant[name]
+            rej = sum(t["rejects"].values())
+            denom = t["count"] + rej
+            out[name] = {
+                "requests": denom,
+                "served": t["count"],
+                "rejected": dict(sorted(t["rejects"].items())),
+                "deadline_miss_rate": (
+                    round((t["misses"] + rej) / denom, 6)
+                    if denom else None),
+                "served_miss_rate": (
+                    round(t["misses"] / t["count"], 6)
+                    if t["count"] else None),
+                "bytes": t["total_bytes"],
+                "gbps_under_slo": (
+                    round(t["ok_bytes"] / elapsed / 1e9, 6)
+                    if elapsed > 0 else None),
+                **self._pcts(t["hist"]),
+            }
         return out

@@ -27,6 +27,7 @@ safe inside the tpu-audit host tier (telemetry must compile nothing).
 
 from __future__ import annotations
 
+import bisect
 import math
 from typing import Dict, List, Optional, Tuple
 
@@ -126,10 +127,14 @@ class LatencyHistogram:
                        ident: str) -> None:
         """Keep the top-capacity exemplars by (value, seq) — the
         newest wins a value tie, so the retained set is a pure
-        function of the recording order."""
+        function of the recording order.  O(1) for the common case (a
+        full set and a value below the weakest retained one), so a
+        million-sample run pays nothing past warmup."""
         ex = self._exemplars
-        ex.append((value, seq, ident))
-        ex.sort(key=lambda e: (-e[0], -e[1]))
+        if len(ex) >= self.exemplar_capacity and value < ex[-1][0]:
+            return
+        bisect.insort(ex, (value, seq, ident),
+                      key=lambda e: (-e[0], -e[1]))
         del ex[self.exemplar_capacity:]
 
     def exemplars(self) -> List[dict]:

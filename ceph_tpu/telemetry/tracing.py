@@ -151,6 +151,13 @@ class TraceCollector:
         self._lock = make_lock("telemetry.tracing.TraceCollector._lock")
         self.traces: List[TraceContext] = []
         self.dropped = 0
+        # per-tenant sampling overrides (multi-tenant weeks): tenant
+        # name -> sample rate; tenants not listed use ``sample``.
+        # ``dropped_by`` counts max_traces drops per tenant ("" = the
+        # untenanted legacy streams) — the hard memory bound stays
+        # one number (max_traces), the accounting says who paid it
+        self.tenant_sample: Dict[str, float] = {}
+        self.dropped_by: Dict[str, int] = {}
         self._aux_seq = 0
         # background charge intervals: work that aged waiting client
         # requests on the shared clock (the arbiter_hold numerator)
@@ -164,13 +171,23 @@ class TraceCollector:
 
     # -- minting ---------------------------------------------------------
 
-    def sampled(self, num: int) -> bool:
-        if self.sample >= 1.0:
+    def set_tenant_sample(self, rates: Dict[str, float]) -> None:
+        """Install per-tenant sampling rates (replaces the whole
+        map; scenario/week.py sets it from the TenantSpec roster)."""
+        with self._lock:
+            self.tenant_sample = {str(k): float(v)
+                                  for k, v in rates.items()}
+
+    def sampled(self, num: int, tenant: Optional[str] = None) -> bool:
+        rate = self.sample
+        if tenant is not None:
+            rate = self.tenant_sample.get(tenant, rate)
+        if rate >= 1.0:
             return True
-        if self.sample <= 0.0:
+        if rate <= 0.0:
             return False
         draw = zlib.crc32(f"{self.seed}:{num}".encode()) % _SAMPLE_MOD
-        return draw < int(self.sample * _SAMPLE_MOD)
+        return draw < int(rate * _SAMPLE_MOD)
 
     def begin(self, kind: str, num: Optional[int] = None,
               op: str = "", **attrs) -> Optional[TraceContext]:
@@ -180,6 +197,8 @@ class TraceCollector:
         with self._lock:
             if len(self.traces) >= self.max_traces:
                 self.dropped += 1
+                t = str(attrs.get("tenant", ""))
+                self.dropped_by[t] = self.dropped_by.get(t, 0) + 1
                 return None
             if num is None:
                 num = self._aux_seq
@@ -239,7 +258,10 @@ class TraceCollector:
                 "qos": list(self.qos),
                 "retries": list(self.retries),
                 "annotations": list(self.annotations),
-            }
+            } | ({"tenant_sample": dict(sorted(
+                self.tenant_sample.items())),
+                "dropped_by": dict(sorted(self.dropped_by.items()))}
+                if self.tenant_sample or self.dropped_by else {})
 
     def to_json(self, indent: Optional[int] = None) -> str:
         import json
@@ -256,6 +278,7 @@ class TraceCollector:
             self.retries.clear()
             self.annotations.clear()
             self.dropped = 0
+            self.dropped_by.clear()
             self._aux_seq = 0
 
 
@@ -324,10 +347,14 @@ def mint(req) -> None:
     ``arrival`` stamp is the trace's first event, so the trace and the
     SLO ledger measure from the same instant)."""
     c = _active
-    if c is None or not c.sampled(req.req_id):
+    tenant = getattr(req, "tenant", "")
+    if c is None or not c.sampled(req.req_id,
+                                  tenant if tenant else None):
         return
-    ctx = c.begin("client", req.req_id, req.op, plugin=req.plugin,
-                  stripe_size=req.stripe_size)
+    attrs = {"plugin": req.plugin, "stripe_size": req.stripe_size}
+    if tenant:
+        attrs["tenant"] = tenant
+    ctx = c.begin("client", req.req_id, req.op, **attrs)
     if ctx is None:
         return
     ctx.add("admit", req.arrival,

@@ -28,6 +28,7 @@ the chaos/scrub suites); production uses the module default
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import random
 import time
 from dataclasses import dataclass, field
@@ -61,6 +62,51 @@ class FakeClock:
     def sleep(self, seconds: float) -> None:
         self.sleeps.append(seconds)
         self.now += seconds
+
+
+class EventClock(FakeClock):
+    """Discrete-event FakeClock: consumers register future event
+    times (arrivals, deadlines, chaos epochs, …) with ``schedule``,
+    and a runner in fast-forward mode jumps ``now`` straight to
+    ``next_event()`` instead of ticking through the idle gap.
+
+    It is still a FakeClock — ``sleep`` advances ``now`` by exactly
+    the requested amount and records it — so any component holding
+    this clock behaves byte-identically whether the driver ticks or
+    jumps; only the *driver's* choice of sleep lengths changes, and
+    the week runner pins that those choices don't change results
+    (tests/test_tenant_week.py's clock-mode equivalence).
+    """
+
+    def __init__(self, start: float = 0.0) -> None:
+        super().__init__(start)
+        self._events: List[float] = []
+        self.jumps = 0
+
+    def schedule(self, t: float) -> None:
+        """Register an absolute event time (past times are fine —
+        they surface immediately)."""
+        heapq.heappush(self._events, float(t))
+
+    def next_event(self) -> Optional[float]:
+        """Earliest scheduled time still in the future (stale entries
+        at or before ``now`` are discarded), or None when the heap is
+        drained."""
+        while self._events and self._events[0] <= self.now:
+            heapq.heappop(self._events)
+        return self._events[0] if self._events else None
+
+    def advance_to(self, t: float) -> float:
+        """Fast-forward: one sleep() straight to absolute time ``t``
+        (no-op if ``t`` is not in the future). Returns ``now``."""
+        if t > self.now:
+            self.jumps += 1
+            self.sleep(t - self.now)
+            # land EXACTLY on t: accumulated float error must not
+            # make a jumped clock disagree with a stepped one at the
+            # last ulp (the clock-mode byte-equivalence contract)
+            self.now = float(t)
+        return self.now
 
 
 @dataclass(frozen=True)

@@ -261,11 +261,17 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
+    # metric_version 16 (ISSUE 19): the tenant_week_rows section —
+    # the compressed multi-tenant week whose victim_gbps_under_slo
+    # feeds the bench_diff tenant_isolation category
+    # (tests/test_tenant_week.py pins the fixtures)
+    assert bench.METRIC_VERSION == 16
+    assert "tenant_week_isolation" in dict(bench.TENANT_WEEK_ROWS)
+    assert "victim_gbps_under_slo" in bench.TENANT_WEEK_ROW_FIELDS
     # metric_version 15 (ISSUE 18): the serving section carries the
     # paged twin (serving_mixed_paged) with paged/cached_programs/
     # page_pool — tests/test_serve.py pins the bench_diff
     # serving_padding category
-    assert bench.METRIC_VERSION == 15
     assert "serving_mixed_paged" in dict(bench.SERVING_ROWS)
     assert "--paged" in dict(bench.SERVING_ROWS)["serving_mixed_paged"]
     # metric_version 13 (ISSUE 16): the audit-meta blob stamps

@@ -78,6 +78,14 @@ FLOORS: Dict[str, float] = {
     # category by construction, but a silent p99-under-contention
     # cliff must still trip the sentinel
     "scenario": 0.55,
+    # tenant-week isolation (ISSUE 19): the victims' GB/s-under-SLO
+    # with the noisy tenant's burst storm raging, arbiter on.  The
+    # whole week is a deterministic EventClock simulation (modeled
+    # service time, no wall clock), so the series repeats exactly
+    # from a seed — a tight floor: movement here means the arbiter,
+    # the batcher or the stage machine changed behaviour, not that
+    # the host scheduler hiccuped
+    "tenant_isolation": 0.20,
     # recovery-under-fault (ISSUE 13): the supervised dispatch plane
     # absorbing an injected transient/OOM/backend-loss script — the
     # GB/s includes retries, rung splits, live demotion and program
@@ -169,6 +177,19 @@ def extract_series(rec: dict) -> Dict[str, float]:
             if isinstance(u, (int, float)) and not isinstance(u, bool) \
                     and u > 0:
                 series[f"autotune:{name}"] = float(u)
+    # tenant-week rows (ISSUE 19): the victims' GB/s-under-SLO is
+    # the isolation series — unlike this row's aggregate gbps (which
+    # the noisy tenant's clamped storm dominates), it is what the
+    # arbiter exists to protect
+    body = rec.get("tenant_week_rows")
+    if isinstance(body, dict):
+        for name, row in sorted(body.items()):
+            if not isinstance(row, dict):
+                continue
+            g = row.get("victim_gbps_under_slo")
+            if isinstance(g, (int, float)) and not isinstance(g, bool) \
+                    and g > 0:
+                series[f"tenant_isolation:{name}"] = float(g)
     # serving + scenario rows: GB/s-under-SLO is the series (raw
     # gbps as the fallback for rows predating the field)
     for section, cat in (("serving_rows", "serving"),

@@ -135,6 +135,17 @@ python tools/scenario_demo.py >/dev/null \
     || { echo "scenario_demo: scenario gate failed"; exit 1; }
 python tools/scenario_demo.py --erasures 4 >/dev/null 2>&1
 [ $? -eq 2 ] || { echo "scenario_demo: expected unrecoverable rc 2"; exit 1; }
+# Tenant-week gates (ISSUE 19 / docs/SCENARIOS.md "Multi-tenant
+# weeks"): the seeded 3-tenant compressed week — diurnal streams
+# under per-tenant mClock, scrub/churn cadences, and the staged
+# disaster schedule (rack loss at peak, backend loss, host loss,
+# noisy-neighbor burst) on the discrete-event clock — must hold
+# every gate at rc 0: byte-identical replay, discrete-event ==
+# stepped-clock report identity, every disaster healed with zero
+# data loss, the isolation gate green arbiter-on AND red on the
+# arbiter-off control arm.
+python tools/tenant_week_demo.py >/dev/null \
+    || { echo "tenant_week_demo: multi-tenant week gate failed"; exit 1; }
 # Supervised-dispatch-plane gates (ISSUE 13 / docs/ROBUSTNESS.md
 # "Supervised dispatch plane"): a seeded production day that loses
 # its device backend mid-stream (persistent DispatchFault at the warm
