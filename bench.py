@@ -185,7 +185,13 @@ LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
 # burst storm raging (the bench_diff `tenant_isolation` series).
 # The whole week is a deterministic EventClock simulation, so the
 # row is identical on the host-only error line.
-METRIC_VERSION = 16
+# v17 (ISSUE 20, determinism-discipline tier): the audit-meta blob
+# gains `detcheck` — whether the runtime determinism tripwire
+# (CEPH_TPU_DETCHECK=1, utils/detcheck.py) was live for the run:
+# tripwired clock seams add a witness branch per consultation, so a
+# detcheck row must never be compared against production numbers
+# (the same non-comparability rule as `lockcheck`).
+METRIC_VERSION = 17
 
 NORTH_STAR = ["--plugin", "jerasure",
               "--parameter", "technique=reed_sol_van",
@@ -777,6 +783,7 @@ def _audit_meta() -> dict:
     try:
         from ceph_tpu.analysis.entrypoints import registry
         from ceph_tpu.analysis.jaxpr_audit import AUDIT_RULE_IDS
+        from ceph_tpu.utils.detcheck import detcheck_enabled
         from ceph_tpu.utils.locks import lockcheck_enabled
         return {
             "audited_entrypoints": len(registry()),
@@ -786,10 +793,14 @@ def _audit_meta() -> dict:
             # bookkeeping step per acquire, so a row measured under
             # lockcheck is not comparable to a production row
             "lockcheck": lockcheck_enabled(),
+            # same rule for the determinism tripwire
+            # (CEPH_TPU_DETCHECK=1): wrapped clock seams add a
+            # witness branch per consultation
+            "detcheck": detcheck_enabled(),
         }
     except Exception:  # noqa: BLE001 — metadata must never kill a bench
         return {"audited_entrypoints": None, "audit_rules": [],
-                "lockcheck": False}
+                "lockcheck": False, "detcheck": False}
 
 
 def _error_line(msg: str, cpp_gbps: float, cpp_src: str,

@@ -53,12 +53,23 @@ from .jaxpr_audit import (AUDIT_RULE_IDS, EntryAudit, TraceReport,
 # against.  Pure AST, jax-free, like the AST tier.
 from .concurrency import (CONC_RULE_IDS, CONC_RULES, lint_conc_paths,
                           scan_paths, static_lock_graph)
+# det tier: static replay-safety analysis + the declarative replay
+# domain/seam registry its runtime half (utils/detcheck.py) validates
+# against.  Pure AST, jax-free, like the AST and conc tiers.
+from .determinism import DET_RULE_IDS, DET_RULES, lint_det_paths
+from .replaymodel import (CLOCK_FALLBACKS, DOMAINS, ENV_SEAMS,
+                          domain_kind, fallback_ids, is_replay)
 
 __all__ = [
     "ALL_RULES",
     "AUDIT_RULE_IDS",
+    "CLOCK_FALLBACKS",
     "CONC_RULES",
     "CONC_RULE_IDS",
+    "DET_RULES",
+    "DET_RULE_IDS",
+    "DOMAINS",
+    "ENV_SEAMS",
     "EntryAudit",
     "EntryPoint",
     "FileReport",
@@ -69,7 +80,11 @@ __all__ = [
     "TraceReport",
     "audit_entry_point",
     "audit_registry",
+    "domain_kind",
+    "fallback_ids",
+    "is_replay",
     "lint_conc_paths",
+    "lint_det_paths",
     "lint_file",
     "lint_paths",
     "registry",

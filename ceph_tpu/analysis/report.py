@@ -12,7 +12,7 @@ from .scanner import LintReport
 
 # bumped to 2 when the conc tier landed: every JSON payload now
 # carries ``lint_schema_version`` + ``tier`` so CI consumers can tell
-# the three machine-readable reports (ast | trace | conc) apart
+# the four machine-readable reports (ast | trace | conc | det) apart
 LINT_SCHEMA_VERSION = 2
 
 
@@ -57,6 +57,7 @@ def render_json(report: LintReport, tier: str = "ast") -> str:
 
 def render_rules() -> str:
     from .concurrency import CONC_RULES
+    from .determinism import DET_RULES
 
     lines = []
     for rule in ALL_RULES:
@@ -64,6 +65,9 @@ def render_rules() -> str:
         lines.append(f"    {rule.description}")
     for rule in CONC_RULES:
         lines.append(f"{rule.id} [{rule.category}] (--conc)")
+        lines.append(f"    {rule.description}")
+    for rule in DET_RULES:
+        lines.append(f"{rule.id} [{rule.category}] (--det)")
         lines.append(f"    {rule.description}")
     return "\n".join(lines)
 

@@ -140,17 +140,20 @@ def _stale_findings(rel_path: str, pragmas, config: LintConfig
                     ) -> List[Finding]:
     """``disable=`` pragmas whose AST-tier rules matched nothing this
     scan.  Trace-tier (``audit-*``) pragmas are the jaxpr auditor's to
-    judge (jaxpr_audit.stale_trace_pragmas), and concurrency-tier
+    judge (jaxpr_audit.stale_trace_pragmas), concurrency-tier
     (``conc-*``) pragmas the lock analyzer's
-    (concurrency.lint_conc_paths); both skipped here.  Only
-    meaningful on full-rule runs: a ``--rule``-filtered scan never
-    marks the other rules' pragmas stale."""
+    (concurrency.lint_conc_paths), and determinism-tier (``det-*``)
+    pragmas the replay analyzer's (determinism.lint_det_paths); all
+    skipped here.  Only meaningful on full-rule runs: a
+    ``--rule``-filtered scan never marks the other rules' pragmas
+    stale."""
     if config.enabled_rules is not None:
         return []
     out: List[Finding] = []
     for s in pragmas.suppressions:
         for rule in sorted(s.stale_rules()):
             if (rule.startswith("audit-") or rule.startswith("conc-")
+                    or rule.startswith("det-")
                     or rule in config.disabled_rules):
                 continue
             line = s.line or 1

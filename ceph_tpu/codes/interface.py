@@ -102,7 +102,7 @@ class ErasureCodeInterface(abc.ABC):
         blind = set(self.minimum_to_decode(want_to_read, avail))
         if len(set(available.values())) <= 1:
             return blind            # flat costs: nothing to trade off
-        blind_cost = sum(available[c] for c in blind)
+        blind_cost = sum(available[c] for c in sorted(blind))
         best, best_cost = blind, blind_cost
         for c in sorted(avail, key=lambda c: (-available[c], -c)):
             trial = avail - {c}
@@ -110,7 +110,7 @@ class ErasureCodeInterface(abc.ABC):
                 mini = set(self.minimum_to_decode(want_to_read, trial))
             except (IOError, ValueError):
                 continue            # c is load-bearing; keep it
-            cost = sum(available[x] for x in mini)
+            cost = sum(available[x] for x in sorted(mini))
             if cost <= best_cost:
                 avail, best, best_cost = trial, mini, cost
         # equal-cost drops above are PROVISIONAL (they unmask chained

@@ -76,7 +76,7 @@ def decode_plan(matrix: np.ndarray, k: int, w: int, available: frozenset,
 
     # data unknowns forced by wanted-but-erased chunks
     base_unknown = set(want_data_erased)
-    for i in want_par_erased:
+    for i in sorted(want_par_erased):
         base_unknown |= windows[i] & erased_data
 
     avail_par = sorted(i for i in range(m) if k + i in available)

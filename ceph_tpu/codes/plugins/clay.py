@@ -450,7 +450,7 @@ class ErasureCodeClay(ErasureCode):
         want = set(want_to_read)
         available = set(chunks)
         if want <= available:
-            return {i: chunks[i] for i in want}
+            return {i: chunks[i] for i in sorted(want)}
         if self.is_repair(want, available):
             return self._repair(want, chunks, chunk_size)
         return self._decode_full(want, chunks, chunk_size)
@@ -570,7 +570,7 @@ class ErasureCodeClay(ErasureCode):
         # order repair planes by aloof-dot intersection score
         U = np.zeros_like(C)
         u_known = np.zeros((self.n_nodes, sub), dtype=bool)
-        orders = {z: sum(1 for n in aloof
+        orders = {z: sum(1 for n in sorted(aloof)
                          if self._digit(z, n // q) == n % q)
                   for z in planes}
         for iscore in range(max(orders.values()) + 1 if planes else 0):

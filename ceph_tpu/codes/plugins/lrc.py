@@ -411,7 +411,7 @@ class ErasureCodeLrc(ErasureCode):
         want = set(want_to_read)
         known = dict(chunks)
         if want <= set(known):
-            return {i: known[i] for i in want}
+            return {i: known[i] for i in sorted(want)}
         layers = sorted(self.layers, key=lambda L: len(L.positions))
         progress = True
         while (want - set(known)) and progress:
@@ -427,7 +427,8 @@ class ErasureCodeLrc(ErasureCode):
                 try:
                     sub_out = layer.code.decode(
                         {lidx[p] for p in erased},
-                        {lidx[p]: known[p] for p in avail}, chunk_size)
+                        {lidx[p]: known[p] for p in sorted(avail)},
+                        chunk_size)
                 except IOError:
                     continue
                 for p in erased:
@@ -437,7 +438,7 @@ class ErasureCodeLrc(ErasureCode):
             raise IOError(
                 f"cannot decode {sorted(want - set(known))} from "
                 f"available {sorted(chunks)}")
-        return {i: known[i] for i in want}
+        return {i: known[i] for i in sorted(want)}
 
     def decode_chunks(self, want_to_read: set, chunks: Dict[int, bytes],
                       decoded: Dict[int, bytes]) -> Dict[int, bytes]:

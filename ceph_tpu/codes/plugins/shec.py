@@ -166,7 +166,7 @@ class ErasureCodeShec(MatrixCodeMixin, ErasureCode):
         available = frozenset(chunks)
         want = frozenset(want_to_read)
         if want <= available:
-            return {i: chunks[i] for i in want}
+            return {i: chunks[i] for i in sorted(want)}
         plan = self.tcache.get_plan(self.matrix, self.k, self.w,
                                     available, want)
         stack = np.stack([np.frombuffer(chunks[c], dtype=np.uint8)

@@ -170,7 +170,7 @@ def calc_pg_upmaps(m: OSDMap, pool_id=None, max_deviation: float = 1.0,
                                          m.pools[pid].crush_rule)
                 for pid in pool_ids}
     fd_of_by_type: Dict[int, Dict[int, Optional[int]]] = {}
-    for fdt in set(fd_types.values()):
+    for fdt in sorted(set(fd_types.values()), key=lambda t: t or 0):
         if fdt:
             fd_of_by_type[fdt] = {
                 o: ancestor_of_type(m.crush, o, fdt, parents)

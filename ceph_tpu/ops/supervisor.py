@@ -80,6 +80,7 @@ import numpy as np
 from ..utils.errors import (ProbeTimeout, RetryExhausted,
                             TransientBackendError)
 from ..utils.log import dout
+from ..utils.detcheck import default_clock
 from ..utils.retry import RetryPolicy, SystemClock, retry_call
 from ..utils.locks import make_lock
 
@@ -195,7 +196,9 @@ class DispatchSupervisor:
                  policy=None,
                  cache_clear: Optional[Callable[[], None]] = None,
                  plane_ctl: bool = True) -> None:
-        self.clock = clock if clock is not None else SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("ops.supervisor.DispatchSupervisor",
+                               SystemClock)
         self.retry_policy = retry_policy or RetryPolicy(
             attempts=3, base_delay=0.002,  # tpu-lint: disable=gf-float -- backoff seconds, not GF math
             multiplier=2.0,  # tpu-lint: disable=gf-float -- backoff multiplier, not GF math

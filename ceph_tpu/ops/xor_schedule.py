@@ -371,7 +371,7 @@ def _build_cse(matrix_t, naive: int, dense_vpu: int,
     em = _Emitter(s)
     node_of: Dict[int, int] = {}
     max_t: Dict[int, int] = {}
-    for v in used:
+    for v in sorted(used):
         j, t = divmod(v, W)
         max_t[j] = max(max_t.get(j, 0), t)
     for j in sorted(max_t):
@@ -384,7 +384,7 @@ def _build_cse(matrix_t, naive: int, dense_vpu: int,
         na, nb = node_of[a], node_of[b]
         node_of[n_planes + ti] = em.emit(("xor", min(na, nb),
                                           max(na, nb)))
-    outputs = [em.fold_xor([node_of[v] for v in row])
+    outputs = [em.fold_xor([node_of[v] for v in sorted(row)])
                for row in final_rows]
     return _finish(em, outputs, matrix_t, naive, dense_vpu, "cse")
 
@@ -613,7 +613,7 @@ def probe_bitmatrix_schedule(rows_masks: tuple, w: int
     for ti, (a, b) in enumerate(temps):
         na, nb = node_of[a], node_of[b]
         node_of[s_in + ti] = em.emit(("xor", min(na, nb), max(na, nb)))
-    outputs = [em.fold_xor([node_of[v] for v in row])
+    outputs = [em.fold_xor([node_of[v] for v in sorted(row)])
                for row in final_rows]
     num, den = BITMATRIX_MIN_SAVINGS
     if naive == 0 or (naive - em.xor_ops) * den < num * naive:

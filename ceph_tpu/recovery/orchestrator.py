@@ -47,6 +47,7 @@ from ..scrub.deep_scrub import deep_scrub, repair_batched, \
 from ..telemetry import metrics as tel
 from ..telemetry import tracing
 from ..telemetry.spans import global_tracer
+from ..utils.detcheck import default_clock
 from ..utils.errors import InjectedCrash
 from ..utils.log import dout
 from ..utils.retry import RetryPolicy, SystemClock
@@ -199,7 +200,10 @@ class RecoveryOrchestrator:
         self.journal = journal if journal is not None else IntentJournal()
         self.throttle = throttle or OsdRecoveryThrottle()
         self.retry_policy = retry_policy or RetryPolicy()
-        self.clock = clock or SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock(
+                "recovery.orchestrator.RecoveryOrchestrator",
+                SystemClock)
         self.crashpoint = crashpoint
         self.churn = churn
         self.device = device

@@ -75,11 +75,14 @@ class MClockArbiter:
 
     def __init__(self, spec=None, clock=None, enabled: Optional[bool]
                  = None) -> None:
+        from ..utils.detcheck import default_clock
         from ..utils.retry import SystemClock
         from .spec import QosSpec
 
         self.spec = spec if spec is not None else QosSpec()
-        self.clock = clock if clock is not None else SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("scenario.qos.MClockArbiter",
+                               SystemClock)
         self.enabled = (self.spec.enabled if enabled is None
                         else enabled)
         self._state: Dict[str, _ClassState] = {

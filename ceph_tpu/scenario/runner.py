@@ -89,10 +89,13 @@ def run_serving_scenario(spec, clock=None, executor: str = "device",
     from ..serve.loadgen import LoadGenerator, ServingRun
     from ..serve.queue import AdmissionQueue
     from ..serve.sla import SlaRecorder, SloPolicy
+    from ..utils.detcheck import default_clock
     from ..utils.retry import SystemClock
 
     if clock is None:
-        clock = SystemClock()
+        clock = default_clock(
+            "scenario.runner.run_serving_scenario",
+                                         SystemClock)
     # the CEPH_TPU_TRACE opt-in: a causal-trace collector for this
     # run when the env knob asks and none is active (no-op otherwise;
     # tracing is off by default — docs/OBSERVABILITY.md)
@@ -403,12 +406,14 @@ def run_scenario(spec, *, clock=None, executor: str = "host",
     from ..recovery.orchestrator import RecoveryOrchestrator, healed
     from ..recovery.throttle import OsdRecoveryThrottle
     from ..scrub.deep_scrub import deep_scrub
+    from ..utils.detcheck import default_clock
     from ..utils.retry import SystemClock
     from .qos import MClockArbiter
     from .report import ScenarioReport
 
     if clock is None:
-        clock = SystemClock()
+        clock = default_clock("scenario.runner.run_scenario",
+                              SystemClock)
     tracing.maybe_install_from_env(clock=clock, seed=spec.seed)
     sim = service_model is not None
     chaos = spec.chaos

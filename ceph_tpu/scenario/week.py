@@ -49,6 +49,7 @@ composition is pinned by a CRC over the batcher's dispatch log.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import zlib
 from dataclasses import dataclass, field
@@ -111,6 +112,36 @@ def week_service_model(spec):
                                     overhead_s=spec.service_overhead_s)
 
 
+@contextlib.contextmanager
+def _instruments_on(clock):
+    """Point the process-global telemetry instruments (metrics
+    registry, flight recorder, span tracer) at the scenario clock for
+    the duration of a simulated run, restoring after.  Swapping the
+    clock *attribute* — not the instruments themselves — preserves
+    counter continuity for tests that read global counters post-run,
+    and keeps the run's breadcrumb/series stamps on simulated time so
+    a byte-identity rerun stamps identically (and CEPH_TPU_DETCHECK
+    sees zero wall-clock trips).  The global dispatch supervisor
+    rides along too: its retry backoffs and hang-watch deadlines must
+    charge *simulated* time, or every heal round burns real wall time
+    and stamps nondeterministic elapsed values."""
+    from ..ops.supervisor import global_supervisor
+    from ..telemetry import global_metrics
+    from ..telemetry.recorder import global_flight_recorder
+    from ..telemetry.spans import global_tracer
+
+    insts = (global_metrics(), global_flight_recorder(),
+             global_tracer(), global_supervisor())
+    saved = [inst.clock for inst in insts]
+    for inst in insts:
+        inst.clock = clock
+    try:
+        yield
+    finally:
+        for inst, prev in zip(insts, saved):
+            inst.clock = prev
+
+
 def run_tenant_week(spec, *, clock=None, executor: str = "host",
                     service_model=None, enable_arbiter=None,
                     clock_mode: str = "event",
@@ -123,10 +154,37 @@ def run_tenant_week(spec, *, clock=None, executor: str = "host",
     is a simulation; the service model charges modeled dispatch time
     to the shared clock, which is the contention mechanism.
 
-    ``clock_mode="event"`` fast-forwards idle gaps in one jump;
-    ``"step"`` ticks through them in ``clock_step_s`` quanta.  Both
-    produce byte-identical reports — pinned by the equivalence test.
+    ``clock_mode="event"`` advances with ONE sleep per gap;
+    ``"step"`` ticks through the same gap in ``clock_step_s`` quanta.
+    Both produce byte-identical reports — pinned by the equivalence
+    test.
+
+    The whole run executes inside a ``utils.detcheck``
+    *injected-clock window* with the global telemetry instruments
+    riding the scenario clock: under ``CEPH_TPU_DETCHECK=1`` any
+    component falling back to real wall time mid-week is counted and
+    flight-recorded as a trip (tests/test_detcheck.py pins zero).
     """
+    from ..utils.detcheck import injected_clock
+    from ..utils.retry import EventClock
+
+    if clock is None:
+        clock = EventClock()
+    if not hasattr(clock, "now"):
+        raise ValueError("run_tenant_week is a simulation: it needs "
+                         "a FakeClock-family clock (EventClock)")
+    with injected_clock(f"tenant_week:{spec.name}"), \
+            _instruments_on(clock):
+        return _run_week_body(spec, clock=clock, executor=executor,
+                              service_model=service_model,
+                              enable_arbiter=enable_arbiter,
+                              clock_mode=clock_mode,
+                              clock_step_s=clock_step_s)
+
+
+def _run_week_body(spec, *, clock, executor, service_model,
+                   enable_arbiter, clock_mode,
+                   clock_step_s) -> TenantWeekRun:
     from ..chaos import ShardErasure
     from ..chaos.adversaries import MapChurn
     from ..chaos.dispatch import DispatchFault, DispatchFaultPlan, \
@@ -157,11 +215,6 @@ def run_tenant_week(spec, *, clock=None, executor: str = "host",
     if clock_mode not in ("event", "step"):
         raise ValueError(f"clock_mode {clock_mode!r} must be "
                          f"event|step")
-    if clock is None:
-        clock = EventClock()
-    if not hasattr(clock, "now"):
-        raise ValueError("run_tenant_week is a simulation: it needs "
-                         "a FakeClock-family clock (EventClock)")
     if service_model is None:
         service_model = week_service_model(spec)
     tracing.maybe_install_from_env(clock=clock, seed=spec.seed)
@@ -516,7 +569,7 @@ def run_tenant_week(spec, *, clock=None, executor: str = "host",
                     j = state["scrub_idx"] % len(scrub_stores)
                     state["scrub_idx"] += 1
                     deep_scrub(sinfo, ec, scrub_stores[j],
-                               scrub_hinfos[j])
+                               scrub_hinfos[j], clock=clock)
                     state["scrub_ticks"] += 1
                     if sim:
                         _charge(spec.scrub_tick_s)

@@ -58,6 +58,7 @@ import numpy as np
 from ..telemetry import metrics as tel
 from ..telemetry import span
 from ..telemetry import tracing
+from ..utils.detcheck import default_clock
 from ..utils.log import dout
 from .pool import (PagedStripePool, PoolExhausted, effective_page_size,
                    tuned_pool_config)
@@ -89,6 +90,24 @@ def tuned_ladder(default: Tuple[int, ...] = LADDER) -> Tuple[int, ...]:
 
 # EWMA smoothing for the per-bucket service-time estimate
 _EWMA_ALPHA = 0.3
+
+# fault-injection seam for tools/replay_bisect.py: when set, every
+# measured service time passes through this hook BEFORE the EWMA
+# update, so the perturbation propagates into slack deadlines and
+# changes downstream batch composition — exactly the kind of quiet
+# nondeterminism the bisector exists to localize.  Signature:
+# (service_s, dispatch_index) -> service_s.  Never set in production;
+# the replay_bisect self-test installs a deterministic jitter on run
+# B only and pins the first divergent checkpoint.
+_SERVICE_JITTER: Optional[Callable[[float, int], float]] = None
+
+
+def set_service_jitter(
+        fn: Optional[Callable[[float, int], float]]) -> None:
+    """Install (or clear, with ``None``) the service-time jitter
+    hook.  Test/bisect seam — see ``_SERVICE_JITTER`` above."""
+    global _SERVICE_JITTER
+    _SERVICE_JITTER = fn
 
 # floor on the service estimate (seconds): a fresh bucket with no
 # dispatch history must still fire BEFORE its deadline by enough to
@@ -208,7 +227,9 @@ class ContinuousBatcher:
         if tuple(ladder) != tuple(sorted(set(ladder))):
             raise ValueError(f"ladder {ladder} must be strictly "
                              f"increasing")
-        self.clock = clock if clock is not None else SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("serve.batcher.ContinuousBatcher",
+                               SystemClock)
         self.ladder = tuple(ladder)
         self.executor = executor
         self.service_model = service_model
@@ -505,6 +526,8 @@ class ContinuousBatcher:
                 self.clock.sleep(self.service_model(b, rung))
         t1 = self.clock.monotonic()
         service = t1 - t0
+        if _SERVICE_JITTER is not None:
+            service = _SERVICE_JITTER(service, self.dispatches)
         self._est[b.key] = (service if b.key not in self._est else
                             (1 - _EWMA_ALPHA) * self._est[b.key]
                             + _EWMA_ALPHA * service)
@@ -619,6 +642,8 @@ class ContinuousBatcher:
                 self.clock.sleep(self.service_model(q, live))
         t1 = self.clock.monotonic()
         service = t1 - t0
+        if _SERVICE_JITTER is not None:
+            service = _SERVICE_JITTER(service, self.dispatches)
         self._est[q.key] = (service if q.key not in self._est else
                             (1 - _EWMA_ALPHA) * self._est[q.key]
                             + _EWMA_ALPHA * service)

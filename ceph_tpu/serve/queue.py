@@ -129,9 +129,12 @@ class AdmissionQueue:
     def __init__(self, clock=None, capacity: int = 4096,
                  slo=None) -> None:
         from .sla import SloPolicy
+        from ..utils.detcheck import default_clock
         from ..utils.retry import SystemClock
 
-        self.clock = clock if clock is not None else SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("serve.queue.AdmissionQueue",
+                               SystemClock)
         self.capacity = capacity
         self.slo = slo if slo is not None else SloPolicy()
         self._lock = make_lock("serve.queue.AdmissionQueue._lock")

@@ -37,6 +37,7 @@ from collections import deque
 from typing import Dict, Optional, Tuple
 
 from .histogram import LatencyHistogram
+from ..utils.detcheck import default_clock
 from ..utils.locks import make_lock
 
 LabelKey = Tuple[Tuple[str, str], ...]
@@ -70,7 +71,9 @@ class MetricsRegistry:
     def __init__(self, name: str = "ceph_tpu_telemetry",
                  clock=None) -> None:
         self.name = name
-        self.clock = clock if clock is not None else _SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("telemetry.metrics.MetricsRegistry",
+                               _SystemClock)
         self._lock = make_lock("telemetry.metrics.MetricsRegistry._lock")
         self._counters: Dict[SeriesKey, int] = {}
         self._gauges: Dict[SeriesKey, float] = {}

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .histogram import LatencyHistogram
 from .metrics import series_name
+from ..utils.detcheck import default_clock
 from ..utils.locks import make_lock
 
 # Nominal peak memory bandwidth per jax platform, GB/s — the roofline
@@ -184,7 +185,9 @@ class ProgramProfiler:
     a failed repair."""
 
     def __init__(self, clock=None) -> None:
-        self.clock = clock if clock is not None else _SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("telemetry.profiler.ProgramProfiler",
+                               _SystemClock)
         self._lock = make_lock("telemetry.profiler.ProgramProfiler._lock")
         self._records: Dict[tuple, ProgramRecord] = {}
         self.captures = 0

@@ -48,6 +48,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..utils.detcheck import default_clock
 from ..utils.log import dout
 from ..utils.locks import make_lock
 
@@ -77,7 +78,9 @@ class FlightRecorder:
 
     def __init__(self, clock=None, max_entries: int = MAX_ENTRIES,
                  max_dumps: int = MAX_DUMPS) -> None:
-        self.clock = clock if clock is not None else _SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("telemetry.recorder.FlightRecorder",
+                               _SystemClock)
         self._lock = make_lock("telemetry.recorder.FlightRecorder._lock")
         self._entries: "deque[dict]" = deque(maxlen=max_entries)
         self._seq = 0

@@ -38,6 +38,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..utils.detcheck import default_clock
 from ..utils.log import dout
 from ..utils.locks import make_lock
 
@@ -95,7 +96,9 @@ class SpanTracer:
 
     def __init__(self, clock=None, max_roots: int = 256,
                  annotate: Optional[bool] = None) -> None:
-        self.clock = clock if clock is not None else _SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("telemetry.spans.SpanTracer",
+                               _SystemClock)
         self.annotate = annotate
         self._lock = make_lock("telemetry.spans.SpanTracer._lock")
         self._tls = threading.local()

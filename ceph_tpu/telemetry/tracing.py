@@ -142,9 +142,12 @@ class TraceCollector:
 
     def __init__(self, clock=None, seed: int = 0, sample: float = 1.0,
                  max_traces: int = 4096) -> None:
+        from ..utils.detcheck import default_clock
         from ..utils.retry import SystemClock
 
-        self.clock = clock if clock is not None else SystemClock()
+        self.clock = clock if clock is not None \
+            else default_clock("telemetry.tracing.TraceCollector",
+                               SystemClock)
         self.seed = int(seed)
         self.sample = float(sample)
         self.max_traces = int(max_traces)

@@ -150,7 +150,11 @@ class RetryPolicy:
         # decorrelated jitter: sleep ~ U(base_delay, prev * 3), capped.
         # The first backoff seeds the walk with the plain base delay.
         prev = base if prev_delay is None else prev_delay
-        rng = rng or random.Random()
+        # deterministic fallback: an unseeded Random here would
+        # make a replayed backoff walk diverge run-to-run; jitter
+        # needs decorrelation, not entropy
+        rng = rng if rng is not None \
+            else random.Random(0x9E3779B1 ^ failed_attempt)
         hi = max(self.base_delay, prev * 3.0)
         return min(self.max_delay,
                    rng.uniform(min(self.base_delay, hi), hi))
@@ -185,8 +189,10 @@ def retry_call(fn: Callable, *args,
     asks for it.
     """
     from ..telemetry import metrics as tel
+    from .detcheck import default_clock
     policy = policy or RetryPolicy()
-    clock = clock or SystemClock()
+    clock = clock if clock is not None \
+        else default_clock("utils.retry.retry_call", SystemClock)
     start = clock.monotonic()
     last: Optional[BaseException] = None
     prev_delay: Optional[float] = None
@@ -253,7 +259,9 @@ def probe_call(fn: Callable, *args,
       exactly like the supervisor's slow-dispatch detection).
     """
     from ..telemetry import metrics as tel
-    clock = clock or SystemClock()
+    from .detcheck import default_clock
+    clock = clock if clock is not None \
+        else default_clock("utils.retry.probe_call", SystemClock)
     if policy is None:
         policy = RetryPolicy(attempts=2, deadline=deadline)
     elif policy.deadline is None:

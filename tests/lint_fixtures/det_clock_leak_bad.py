@@ -1,0 +1,7 @@
+"""det-clock-leak red: a bare SystemClock fallback, unwitnessed."""
+from ceph_tpu.utils.retry import SystemClock
+
+
+class Poller:
+    def __init__(self, clock=None):
+        self.clock = clock if clock is not None else SystemClock()

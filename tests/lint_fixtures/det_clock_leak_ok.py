@@ -1,0 +1,6 @@
+"""det-clock-leak green: the clock arrives injected; no fallback."""
+
+
+class Poller:
+    def __init__(self, clock):
+        self.clock = clock

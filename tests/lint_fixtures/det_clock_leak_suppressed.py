@@ -1,0 +1,7 @@
+"""det-clock-leak suppressed: the bare fallback is acknowledged."""
+from ceph_tpu.utils.retry import SystemClock
+
+
+class Poller:
+    def __init__(self, clock=None):
+        self.clock = clock if clock is not None else SystemClock()  # tpu-lint: disable=det-clock-leak -- fixture: acknowledged bare fallback

@@ -1,0 +1,6 @@
+"""det-env-read red: os.environ consulted at call time."""
+import os
+
+
+def mode():
+    return os.environ["CEPH_TPU_MODE"]

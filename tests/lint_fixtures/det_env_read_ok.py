@@ -1,0 +1,8 @@
+"""det-env-read green: configuration is read once at import time."""
+import os
+
+MODE = os.environ.get("CEPH_TPU_MODE", "strict")
+
+
+def mode():
+    return MODE

@@ -1,0 +1,6 @@
+"""det-env-read suppressed: the call-time read is acknowledged."""
+import os
+
+
+def mode():
+    return os.environ["CEPH_TPU_MODE"]  # tpu-lint: disable=det-env-read -- fixture: acknowledged call-time config read

@@ -1,0 +1,6 @@
+"""det-set-order red: a set iterated into an ordered consumer."""
+
+
+def chunk_ids():
+    wanted = {3, 1, 2}
+    return [i for i in wanted]

@@ -1,0 +1,6 @@
+"""det-set-order green: sorted() pins the iteration order."""
+
+
+def chunk_ids():
+    wanted = {3, 1, 2}
+    return [i for i in sorted(wanted)]

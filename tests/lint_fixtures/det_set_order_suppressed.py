@@ -1,0 +1,6 @@
+"""det-set-order suppressed: the iteration is acknowledged."""
+
+
+def chunk_ids():
+    wanted = {3, 1, 2}
+    return [i for i in wanted]  # tpu-lint: disable=det-set-order -- fixture: order acknowledged as unstable

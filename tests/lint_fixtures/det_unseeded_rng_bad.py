@@ -1,0 +1,6 @@
+"""det-unseeded-rng red: process-global RNG in a replay domain."""
+import random
+
+
+def jitter(delay):
+    return delay * random.random()

@@ -1,0 +1,6 @@
+"""det-unseeded-rng green: every draw comes from a seeded generator."""
+import random
+
+
+def jitter(delay, seed):
+    return delay * random.Random(seed).random()

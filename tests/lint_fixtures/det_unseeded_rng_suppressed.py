@@ -1,0 +1,6 @@
+"""det-unseeded-rng suppressed: the draw is acknowledged with a reason."""
+import random
+
+
+def jitter(delay):
+    return delay * random.random()  # tpu-lint: disable=det-unseeded-rng -- fixture: acknowledged entropy draw

@@ -1,0 +1,6 @@
+"""det-wallclock red: real wall time read in a replay-domain function."""
+import time
+
+
+def elapsed(t0):
+    return time.monotonic() - t0

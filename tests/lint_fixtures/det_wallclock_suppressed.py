@@ -1,0 +1,6 @@
+"""det-wallclock suppressed: the read is acknowledged with a reason."""
+import time
+
+
+def elapsed(t0):
+    return time.monotonic() - t0  # tpu-lint: disable=det-wallclock -- fixture: wall time acknowledged for the demo

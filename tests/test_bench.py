@@ -261,11 +261,16 @@ def test_bench_metric_version_and_slice_field(monkeypatch):
     carry-chain candidates while the slice-chain number rides in the
     separate slice_gbps field."""
     import bench
+    # metric_version 17 (ISSUE 20): the audit-meta blob stamps
+    # whether the runtime determinism tripwire was live
+    # (CEPH_TPU_DETCHECK=1) — detcheck rows never compare against
+    # production rows, same rule as lockcheck
+    assert bench.METRIC_VERSION == 17
+    assert "detcheck" in bench._audit_meta()
     # metric_version 16 (ISSUE 19): the tenant_week_rows section —
     # the compressed multi-tenant week whose victim_gbps_under_slo
     # feeds the bench_diff tenant_isolation category
     # (tests/test_tenant_week.py pins the fixtures)
-    assert bench.METRIC_VERSION == 16
     assert "tenant_week_isolation" in dict(bench.TENANT_WEEK_ROWS)
     assert "victim_gbps_under_slo" in bench.TENANT_WEEK_ROW_FIELDS
     # metric_version 15 (ISSUE 18): the serving section carries the

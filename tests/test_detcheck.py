@@ -1,0 +1,155 @@
+"""CEPH_TPU_DETCHECK (ISSUE 20): the runtime determinism tripwire.
+
+- gate semantics: disabled (the default) returns factory clocks
+  untouched — zero wrapper overhead; enabled wraps them in the
+  tripwire;
+- trip semantics: a wall-clock consultation counts ONLY while an
+  injected-clock window is open, per-seam, flight-recorded;
+- the schema-versioned report + its validator;
+- the acceptance criterion: the full multi-tenant disaster week runs
+  under CEPH_TPU_DETCHECK=1 with ZERO wall-clock trips (subprocess,
+  because the gate is creation-time).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from ceph_tpu.utils import detcheck  # noqa: E402
+from ceph_tpu.utils.retry import SystemClock  # noqa: E402
+
+
+@pytest.fixture
+def fresh_monitor(monkeypatch):
+    monkeypatch.setenv(detcheck.DETCHECK_ENV, "1")
+    yield detcheck.reset_monitor()
+    detcheck.reset_monitor()
+
+
+# ----------------------------------------------------------------------
+# gate semantics
+
+def test_disabled_gate_returns_factory_result_untouched(monkeypatch):
+    monkeypatch.delenv(detcheck.DETCHECK_ENV, raising=False)
+    clock = detcheck.default_clock("utils.retry.retry_call",
+                                   SystemClock)
+    assert type(clock) is SystemClock
+
+
+def test_enabled_gate_wraps_in_tripwire(monkeypatch, fresh_monitor):
+    clock = detcheck.default_clock("utils.retry.retry_call",
+                                   SystemClock)
+    assert type(clock) is not SystemClock
+    assert clock.monotonic() > 0  # forwards to the real clock
+
+
+# ----------------------------------------------------------------------
+# trip semantics
+
+def test_no_trip_outside_injected_window(fresh_monitor):
+    clock = detcheck.default_clock("utils.retry.retry_call",
+                                   SystemClock)
+    clock.monotonic()
+    assert fresh_monitor.report()["total_trips"] == 0
+
+
+def test_trip_inside_injected_window(fresh_monitor):
+    clock = detcheck.default_clock("utils.retry.retry_call",
+                                   SystemClock)
+    with detcheck.injected_clock("test-window"):
+        clock.monotonic()
+        clock.monotonic()
+    rep = fresh_monitor.report()
+    assert rep["total_trips"] == 2
+    assert rep["trips"] == {"utils.retry.retry_call": 2}
+    assert rep["trip_events"][0]["window"] == "test-window"
+    assert rep["trip_events"][0]["op"] == "monotonic"
+    # window closed: consultations stop counting
+    clock.monotonic()
+    assert fresh_monitor.report()["total_trips"] == 2
+
+
+def test_nested_windows_count_as_one(fresh_monitor):
+    clock = detcheck.default_clock("utils.retry.probe_call",
+                                   SystemClock)
+    with detcheck.injected_clock("outer"):
+        with detcheck.injected_clock("inner"):
+            pass
+        clock.monotonic()  # outer window still open
+    assert fresh_monitor.report()["total_trips"] == 1
+
+
+def test_trip_event_ring_is_bounded(fresh_monitor):
+    for _ in range(detcheck.MAX_TRIP_EVENTS + 50):
+        fresh_monitor.record_trip("s", "monotonic")
+    rep = fresh_monitor.report()
+    assert rep["total_trips"] == detcheck.MAX_TRIP_EVENTS + 50
+    assert len(rep["trip_events"]) == detcheck.MAX_TRIP_EVENTS
+
+
+def test_injected_clock_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv(detcheck.DETCHECK_ENV, raising=False)
+    mon = detcheck.reset_monitor()
+    with detcheck.injected_clock("ignored"):
+        assert not mon.injected_active()
+
+
+# ----------------------------------------------------------------------
+# report schema
+
+def test_report_validates(fresh_monitor):
+    detcheck.validate_detcheck_report(detcheck.detcheck_report())
+
+
+def test_validator_rejects_tampered_reports(fresh_monitor):
+    doc = detcheck.detcheck_report()
+    bad = dict(doc)
+    bad["detcheck_schema_version"] = 99
+    with pytest.raises(ValueError):
+        detcheck.validate_detcheck_report(bad)
+    bad = dict(doc)
+    del bad["trips"]
+    with pytest.raises(ValueError):
+        detcheck.validate_detcheck_report(bad)
+    with pytest.raises(ValueError):
+        detcheck.validate_detcheck_report("nope")
+
+
+# ----------------------------------------------------------------------
+# the acceptance criterion: a full tenant week, zero trips
+
+_WEEK_UNDER_DETCHECK = """
+import json
+from ceph_tpu.scenario.spec import tenant_week_scenario
+from ceph_tpu.scenario.week import run_tenant_week
+from ceph_tpu.utils.detcheck import detcheck_report
+
+spec = tenant_week_scenario(seed=17, days=2, day_s=6.0,
+                            peak_rates=(40.0, 30.0, 20.0),
+                            burst_factor=80.0)
+run = run_tenant_week(spec)
+rep = detcheck_report()
+print(json.dumps({"enabled": rep["enabled"],
+                  "total_trips": rep["total_trips"],
+                  "trips": rep["trips"],
+                  "ok": run.report.ok()}))
+"""
+
+
+def test_tenant_week_zero_wallclock_trips_under_detcheck():
+    env = dict(os.environ, CEPH_TPU_DETCHECK="1", JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, "-c", _WEEK_UNDER_DETCHECK],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout.strip().splitlines()[-1])
+    assert doc["enabled"] is True
+    assert doc["ok"] is True, doc
+    assert doc["total_trips"] == 0, \
+        f"wall-clock trips during injected-clock week: {doc['trips']}"

@@ -20,6 +20,26 @@ python tools/tpu_lint.py --check-suppressions ceph_tpu/ tools/ bench.py \
 # (CEPH_TPU_LOCKCHECK=1) runs inside tier-1 as tests/test_lockcheck.py.
 python tools/tpu_lint.py --conc --check-suppressions ceph_tpu/ tools/ \
     bench.py || exit 1
+# Determinism gate (det tier, docs/LINT.md): replay-domain code must
+# consult nothing a seeded, clock-injected rerun cannot reproduce —
+# wall clocks, unseeded RNGs, set iteration order, call-time environ
+# reads — with the sanctioned seams declared in analysis/replaymodel.py
+# and cross-checked both ways.  Pure AST, jax-free, seconds.  The
+# runtime half (CEPH_TPU_DETCHECK=1) runs inside tier-1 as
+# tests/test_detcheck.py; tools/replay_bisect.py is the divergence
+# witness.
+python tools/tpu_lint.py --det --check-suppressions ceph_tpu/ tools/ \
+    bench.py || exit 1
+# Determinism smoke (ISSUE 20): the seeded production day must print a
+# byte-identical report from two separate interpreters with DIFFERENT
+# hash seeds — any set-order leak into the report shows up here as a
+# diff before the full suite runs.
+PYTHONHASHSEED=1 python tools/scenario_demo.py --json \
+    > /tmp/ceph_tpu_det_a.json || exit 1
+PYTHONHASHSEED=77 python tools/scenario_demo.py --json \
+    > /tmp/ceph_tpu_det_b.json || exit 1
+cmp -s /tmp/ceph_tpu_det_a.json /tmp/ceph_tpu_det_b.json \
+    || { echo "determinism smoke: report differs across PYTHONHASHSEED"; exit 1; }
 # Trace gate second (ISSUE 5): tpu-audit traces every registered
 # jit-facing entry point (analysis/entrypoints.py) to a jaxpr, runs
 # the audit-* rules + the recompile sentinel, and fails if a public

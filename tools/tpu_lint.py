@@ -11,11 +11,12 @@ Usage:
     python tools/tpu_lint.py --trace --entry clay.decode_chunks_jax
     python tools/tpu_lint.py --list-entrypoints
     python tools/tpu_lint.py --conc ceph_tpu/  # lock/race analysis
+    python tools/tpu_lint.py --det ceph_tpu/   # replay-safety analysis
 
 Exit status: 0 when no unsuppressed findings, 1 otherwise.  Rules,
 suppression syntax (`# tpu-lint: disable=<rule> -- reason`) and the
-four-tier static→trace→conc→runtime sanitizer story are documented
-in docs/LINT.md.
+five-tier static→trace→conc→det→runtime sanitizer story are
+documented in docs/LINT.md.
 
 The AST tier is pure stdlib-ast analysis: it never imports the scanned
 code, so it runs in any environment (no jax needed).  `--trace` runs
@@ -27,8 +28,10 @@ any public plugin device surface is missing from the registry.
 `--conc` runs the concurrency tier (analysis/concurrency.py): lock
 discovery, guard-set inference, the conc-* rules, and the lock-order
 registry cross-check against analysis/lockmodel.py — also pure AST,
-also jax-free.  `--check-suppressions` flags stale pragmas on any
-tier.
+also jax-free.  `--det` runs the determinism tier
+(analysis/determinism.py): the det-* replay-safety rules driven by the
+analysis/replaymodel.py domain/seam registry — also pure AST, also
+jax-free.  `--check-suppressions` flags stale pragmas on any tier.
 """
 
 import argparse
@@ -102,6 +105,22 @@ def _run_conc(args) -> int:
     return 0 if ok else 1
 
 
+def _run_det(args) -> int:
+    from ceph_tpu.analysis.determinism import lint_det_paths
+
+    report = lint_det_paths(
+        args.paths or _default_paths(),
+        check_suppressions=args.check_suppressions)
+    if args.json:
+        print(render_json(report, tier="det"))
+    else:
+        print(render_human(report, show_suppressed=args.show_suppressed,
+                           show_stale=args.check_suppressions,
+                           label="tpu-det"))
+    ok = report.ok and not (args.check_suppressions and report.stale)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpu-lint",
@@ -127,6 +146,10 @@ def main(argv=None) -> int:
                     help="run the concurrency tier (lock discovery, "
                          "guard inference, conc-* rules, lockmodel "
                          "registry cross-check; jax-free)")
+    ap.add_argument("--det", action="store_true",
+                    help="run the determinism tier (det-* replay-"
+                         "safety rules, replaymodel domain/seam "
+                         "registry cross-check; jax-free)")
     ap.add_argument("--entry", action="append", default=None,
                     metavar="NAME",
                     help="with --trace: audit only these entry points")
@@ -151,6 +174,8 @@ def main(argv=None) -> int:
         return _run_trace(args)
     if args.conc:
         return _run_conc(args)
+    if args.det:
+        return _run_det(args)
 
     paths = args.paths or _default_paths()
     config = LintConfig(
